@@ -1,0 +1,16 @@
+"""Data layer: datasets (reference src/util.py:21-106), on-device augmentation
+(replacing the PIL pipeline), and host iteration with device prefetch
+(replacing src/data_loader_ops/my_data_loader.py)."""
+
+from .augment import make_preprocessor, normalize, preprocess_batch, random_crop_flip
+from .datasets import (
+    AUGMENT,
+    DATASET_NAMES,
+    IMAGE_SHAPES,
+    NORM_STATS,
+    NUM_CLASSES,
+    Dataset,
+    make_synthetic,
+    prepare_data,
+)
+from .loader import BatchIterator, prefetch_to_device, shard_for_worker

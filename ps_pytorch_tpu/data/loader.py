@@ -1,0 +1,126 @@
+"""Host-side batch iteration with device prefetch.
+
+Replaces the reference's vendored multiprocessing DataLoader
+(/root/reference/src/data_loader_ops/my_data_loader.py:254-319 — worker pool,
+index/data queues, out-of-order reordering, pin-memory thread). On TPU the
+datasets fit in host RAM as numpy arrays, so "loading" is an index gather;
+the heavy lifting (augment/normalize) happens on-device (augment.py) and
+`prefetch_to_device` keeps one batch in flight, which is the TPU-shaped
+equivalent of the reference's pin-memory + worker prefetch machinery.
+
+The reference shards data implicitly: every worker constructs its own
+independently-shuffled DataLoader over the FULL dataset (distributed_nn.py:
+each rank calls prepare_data; README.md:24 "no data is shipped"). `shard`
+reproduces exactly that (seeded per-worker shuffles of the full set) while
+`shard="disjoint"` offers the sane improvement (true partition).
+"""
+
+from __future__ import annotations
+
+import collections
+from typing import Iterator, Optional
+
+import jax
+import numpy as np
+
+
+class BatchIterator:
+    """Epoch-shuffled minibatch iterator over in-memory arrays.
+
+    Yields dicts {"image": uint8 [B,H,W,C], "label": int32 [B]} as numpy.
+    Drops the last partial batch (static shapes for jit).
+    """
+
+    def __init__(
+        self,
+        images: np.ndarray,
+        labels: np.ndarray,
+        batch_size: int,
+        shuffle: bool = True,
+        seed: int = 0,
+        drop_last: bool = True,
+    ):
+        if len(images) < batch_size:
+            # replicate up to one batch so tiny (test) datasets still yield
+            reps = -(-batch_size // len(images))
+            images = np.concatenate([images] * reps)
+            labels = np.concatenate([labels] * reps)
+        self.images, self.labels = images, labels
+        self.batch_size = batch_size
+        self.shuffle = shuffle
+        self.drop_last = drop_last
+        self._rng = np.random.RandomState(seed)
+        self._epoch = 0
+
+    def __len__(self) -> int:
+        n = len(self.images) // self.batch_size
+        if not self.drop_last and len(self.images) % self.batch_size:
+            n += 1
+        return n
+
+    @property
+    def num_samples(self) -> int:
+        return len(self.images)
+
+    def epoch(self) -> Iterator[dict]:
+        idx = np.arange(len(self.images))
+        if self.shuffle:
+            self._rng.shuffle(idx)
+        self._epoch += 1
+        for start in range(0, len(idx), self.batch_size):
+            batch_idx = idx[start : start + self.batch_size]
+            if len(batch_idx) < self.batch_size and self.drop_last:
+                return
+            yield {
+                "image": self.images[batch_idx],
+                "label": self.labels[batch_idx],
+            }
+
+    def __iter__(self):
+        return self.epoch()
+
+    def forever(self) -> Iterator[dict]:
+        while True:
+            yield from self.epoch()
+
+
+def shard_for_worker(
+    images: np.ndarray,
+    labels: np.ndarray,
+    worker_index: int,
+    num_workers: int,
+    mode: str = "reshuffle",
+    seed: int = 0,
+):
+    """Per-worker data assignment.
+
+    mode="reshuffle": reference parity — every worker sees the full dataset
+    under its own shuffle seed (see module docstring).
+    mode="disjoint": contiguous 1/num_workers partition (improvement).
+    """
+    if mode == "reshuffle":
+        return images, labels, seed + worker_index * 1009
+    if mode == "disjoint":
+        n = len(images) // num_workers
+        lo = worker_index * n
+        return images[lo : lo + n], labels[lo : lo + n], seed
+    raise ValueError(f"unknown shard mode {mode!r}")
+
+
+def prefetch_to_device(
+    iterator: Iterator[dict], size: int = 2, device=None
+) -> Iterator[dict]:
+    """Keep `size` batches ahead on device (reference's pin-memory analogue)."""
+    queue = collections.deque()
+
+    def enqueue(n):
+        for _ in range(n):
+            batch = next(iterator, None)
+            if batch is None:
+                return
+            queue.append(jax.device_put(batch, device))
+
+    enqueue(size)
+    while queue:
+        yield queue.popleft()
+        enqueue(1)

@@ -1,0 +1,101 @@
+"""Model factory — name-keyed, mirroring the reference's `build_model`
+(/root/reference/src/util.py:8-19) but covering the full family list the
+reference ships (src/model_ops/: LeNet, ResNet-18/34/50/101/152,
+VGG-11/13/16/19 +/- BN; the reference factory only wires a subset of these).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+
+from .lenet import LeNet
+from .resnet import ResNet18, ResNet34, ResNet50, ResNet101, ResNet152
+from .vgg import (
+    vgg11, vgg11_bn, vgg13, vgg13_bn, vgg16, vgg16_bn, vgg19, vgg19_bn,
+)
+
+# Name -> constructor. Names match the reference CLI values (`--network`,
+# util.py:10-19) with the extra depths the reference defines but never wires.
+MODEL_REGISTRY = {
+    "LeNet": LeNet,
+    "ResNet18": ResNet18,
+    "ResNet34": ResNet34,
+    "ResNet50": ResNet50,
+    "ResNet101": ResNet101,
+    "ResNet152": ResNet152,
+    "VGG11": vgg11_bn,     # reference maps "VGG11" -> vgg11_bn (util.py:18-19)
+    "VGG11NoBN": vgg11,
+    "VGG13": vgg13_bn,
+    "VGG13NoBN": vgg13,
+    "VGG16": vgg16_bn,
+    "VGG16NoBN": vgg16,
+    "VGG19": vgg19_bn,
+    "VGG19NoBN": vgg19,
+}
+
+# Input spec per dataset: (H, W, C). LeNet expects MNIST shapes; everything
+# else expects 32x32x3 CIFAR/SVHN shapes.
+INPUT_SHAPES = {
+    "LeNet": (28, 28, 1),
+}
+DEFAULT_INPUT_SHAPE = (32, 32, 3)
+
+
+def build_model(
+    model_name: str,
+    num_classes: int = 10,
+    dtype: Any = jnp.float32,
+    bn_axis_name: Optional[str] = None,
+):
+    """Construct a model by CLI name (parity: util.py:8-19)."""
+    if model_name not in MODEL_REGISTRY:
+        raise ValueError(
+            f"unknown model {model_name!r}; choose from {sorted(MODEL_REGISTRY)}"
+        )
+    ctor = MODEL_REGISTRY[model_name]
+    kwargs = dict(num_classes=num_classes, dtype=dtype)
+    if model_name != "LeNet":
+        kwargs["bn_axis_name"] = bn_axis_name
+    return ctor(**kwargs)
+
+
+def input_shape_for(model_name: str) -> Tuple[int, int, int]:
+    return INPUT_SHAPES.get(model_name, DEFAULT_INPUT_SHAPE)
+
+
+def init_model(model, rng: jax.Array, input_shape=None, batch_size: int = 2):
+    """Initialize params (+ batch_stats if the model has BN).
+
+    Returns ``(params, batch_stats)`` where ``batch_stats`` is an empty dict
+    for BN-free models, so callers can treat every model uniformly.
+    """
+    if input_shape is None:
+        input_shape = input_shape_for(type(model).__name__)
+    x = jnp.zeros((batch_size,) + tuple(input_shape), jnp.float32)
+    variables = model.init({"params": rng, "dropout": rng}, x, train=False)
+    params = variables["params"]
+    batch_stats = variables.get("batch_stats", {})
+    return params, batch_stats
+
+
+def apply_model(model, params, batch_stats, x, train: bool = False,
+                dropout_rng: Optional[jax.Array] = None):
+    """Uniform apply: returns (logits, new_batch_stats)."""
+    variables = {"params": params}
+    if batch_stats:
+        variables["batch_stats"] = batch_stats
+    rngs = {"dropout": dropout_rng} if dropout_rng is not None else None
+    if train and batch_stats:
+        logits, mutated = model.apply(
+            variables, x, train=True, mutable=["batch_stats"], rngs=rngs
+        )
+        return logits, mutated["batch_stats"]
+    logits = model.apply(variables, x, train=train, rngs=rngs)
+    return logits, batch_stats
+
+
+def param_count(params) -> int:
+    return sum(int(jnp.size(p)) for p in jax.tree_util.tree_leaves(params))

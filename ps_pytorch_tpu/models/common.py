@@ -1,0 +1,32 @@
+"""Shared building blocks for the model zoo.
+
+Centralizes the conv initializer and BatchNorm configuration so ResNet and VGG
+cannot silently diverge. BN semantics follow the reference's PyTorch defaults
+(torch BatchNorm2d momentum=0.1 -> flax momentum=0.9, eps=1e-5); `axis_name`
+enables cross-replica (synced) BN, while the parity default (None) keeps stats
+local per worker like the reference (distributed_worker.py:239-252).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional
+
+import flax.linen as nn
+
+he_normal = nn.initializers.variance_scaling(2.0, "fan_out", "truncated_normal")
+
+
+def batch_norm(
+    train: bool,
+    dtype: Any,
+    bn_axis_name: Optional[str] = None,
+    **kwargs,
+) -> nn.BatchNorm:
+    return nn.BatchNorm(
+        use_running_average=not train,
+        momentum=0.9,
+        epsilon=1e-5,
+        dtype=dtype,
+        axis_name=bn_axis_name,
+        **kwargs,
+    )

@@ -1,0 +1,40 @@
+"""LeNet in flax.linen (NHWC, TPU-native).
+
+Capability parity with the reference LeNet (/root/reference/src/model_ops/lenet.py:16-37):
+conv(1->20, 5x5, valid) -> maxpool 2x2 -> relu -> conv(20->50, 5x5, valid)
+-> maxpool 2x2 -> relu -> flatten(800) -> fc(500) -> fc(num_classes).
+
+The reference's `LeNetSplit` variant (lenet.py:39-254) exists only to hand-
+pipeline per-layer gradient Isends over MPI; on TPU that overlap is XLA's job
+(latency hiding of the psum), so there is deliberately no "split" model here —
+see ps_pytorch_tpu/parallel/ps.py for where the equivalent capability lives.
+"""
+
+from __future__ import annotations
+
+from typing import Any
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+
+class LeNet(nn.Module):
+    """Classic LeNet for 28x28x1 inputs (MNIST). Matches lenet.py:16-37."""
+
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        del train  # no BN/dropout in LeNet; kept for a uniform model interface
+        x = x.astype(self.dtype)
+        x = nn.Conv(20, (5, 5), strides=(1, 1), padding="VALID", dtype=self.dtype)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = nn.Conv(50, (5, 5), strides=(1, 1), padding="VALID", dtype=self.dtype)(x)
+        x = nn.max_pool(x, (2, 2), strides=(2, 2))
+        x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(500, dtype=self.dtype)(x)
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)

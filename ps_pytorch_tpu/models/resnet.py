@@ -1,0 +1,134 @@
+"""CIFAR-style ResNet family in flax.linen (NHWC, TPU-native).
+
+Capability parity with /root/reference/src/model_ops/resnet.py:14-113:
+BasicBlock/Bottleneck CIFAR ResNets — 3x3 stem (no 7x7, no stem pool),
+4 stages at 64/128/256/512 planes, 4x4 average-pool head, Linear classifier.
+Depths: 18/34 (BasicBlock), 50/101/152 (Bottleneck).
+
+TPU-first re-design decisions (not in the reference):
+- NHWC layout, bf16 compute with f32 params (`dtype` attr) to target the MXU.
+- BatchNorm via flax with optional `bn_axis_name` for cross-replica (synced)
+  statistics. The reference never syncs BN stats across workers — each worker
+  keeps local running stats and the master skips them during weight exchange
+  (distributed_worker.py:239-252) — so `bn_axis_name=None` (local stats) is the
+  parity default, and synced BN is an opt-in improvement.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Optional, Sequence
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .common import batch_norm, he_normal
+
+
+class BasicBlock(nn.Module):
+    """3x3 + 3x3 residual block (resnet.py:14-36). expansion = 1."""
+
+    planes: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+    expansion: int = 1
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype, kernel_init=he_normal)
+        norm = partial(
+            batch_norm, train=train, dtype=self.dtype, bn_axis_name=self.bn_axis_name
+        )
+        out = conv(self.planes, (3, 3), strides=(self.stride, self.stride), padding=1)(x)
+        out = nn.relu(norm()(out))
+        out = conv(self.planes, (3, 3), padding=1)(out)
+        out = norm()(out)
+        shortcut = x
+        if self.stride != 1 or x.shape[-1] != self.expansion * self.planes:
+            shortcut = conv(
+                self.expansion * self.planes, (1, 1), strides=(self.stride, self.stride)
+            )(x)
+            shortcut = norm()(shortcut)
+        return nn.relu(out + shortcut)
+
+
+class Bottleneck(nn.Module):
+    """1x1 -> 3x3 -> 1x1 residual block (resnet.py:39-64). expansion = 4."""
+
+    planes: int
+    stride: int = 1
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+    expansion: int = 4
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype, kernel_init=he_normal)
+        norm = partial(
+            batch_norm, train=train, dtype=self.dtype, bn_axis_name=self.bn_axis_name
+        )
+        out = nn.relu(norm()(conv(self.planes, (1, 1))(x)))
+        out = conv(self.planes, (3, 3), strides=(self.stride, self.stride), padding=1)(out)
+        out = nn.relu(norm()(out))
+        out = norm()(conv(self.expansion * self.planes, (1, 1))(out))
+        shortcut = x
+        if self.stride != 1 or x.shape[-1] != self.expansion * self.planes:
+            shortcut = conv(
+                self.expansion * self.planes, (1, 1), strides=(self.stride, self.stride)
+            )(x)
+            shortcut = norm()(shortcut)
+        return nn.relu(out + shortcut)
+
+
+class ResNet(nn.Module):
+    """CIFAR ResNet trunk (resnet.py:67-97)."""
+
+    block: Any
+    num_blocks: Sequence[int]
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        x = nn.Conv(
+            64, (3, 3), padding=1, use_bias=False, dtype=self.dtype, kernel_init=he_normal
+        )(x)
+        x = batch_norm(train=train, dtype=self.dtype, bn_axis_name=self.bn_axis_name)(x)
+        x = nn.relu(x)
+        for stage, (planes, stride) in enumerate(
+            zip((64, 128, 256, 512), (1, 2, 2, 2))
+        ):
+            for i in range(self.num_blocks[stage]):
+                x = self.block(
+                    planes=planes,
+                    stride=stride if i == 0 else 1,
+                    dtype=self.dtype,
+                    bn_axis_name=self.bn_axis_name,
+                )(x, train=train)
+        x = nn.avg_pool(x, (4, 4), strides=(4, 4))
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def ResNet18(num_classes: int = 10, **kw) -> ResNet:
+    return ResNet(block=BasicBlock, num_blocks=(2, 2, 2, 2), num_classes=num_classes, **kw)
+
+
+def ResNet34(num_classes: int = 10, **kw) -> ResNet:
+    return ResNet(block=BasicBlock, num_blocks=(3, 4, 6, 3), num_classes=num_classes, **kw)
+
+
+def ResNet50(num_classes: int = 10, **kw) -> ResNet:
+    return ResNet(block=Bottleneck, num_blocks=(3, 4, 6, 3), num_classes=num_classes, **kw)
+
+
+def ResNet101(num_classes: int = 10, **kw) -> ResNet:
+    return ResNet(block=Bottleneck, num_blocks=(3, 4, 23, 3), num_classes=num_classes, **kw)
+
+
+def ResNet152(num_classes: int = 10, **kw) -> ResNet:
+    return ResNet(block=Bottleneck, num_blocks=(3, 8, 36, 3), num_classes=num_classes, **kw)

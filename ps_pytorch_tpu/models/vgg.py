@@ -1,0 +1,97 @@
+"""VGG family (CIFAR variant) in flax.linen (NHWC, TPU-native).
+
+Capability parity with /root/reference/src/model_ops/vgg.py:15-108:
+configurations A/B/D/E (VGG-11/13/16/19) with or without BatchNorm, and the
+CIFAR-sized classifier head Dropout -> 512 -> ReLU -> Dropout -> 512 -> ReLU
+-> num_classes. Conv weights use the reference's He/fan-out normal init
+(vgg.py:33-36).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Optional, Sequence, Union
+
+import flax.linen as nn
+import jax.numpy as jnp
+
+from .common import batch_norm, he_normal
+
+# Configuration tables (vgg.py:62-68). 'M' = 2x2 max-pool.
+CFGS = {
+    "A": (64, "M", 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "B": (64, 64, "M", 128, 128, "M", 256, 256, "M", 512, 512, "M", 512, 512, "M"),
+    "D": (64, 64, "M", 128, 128, "M", 256, 256, 256, "M", 512, 512, 512, "M",
+          512, 512, 512, "M"),
+    "E": (64, 64, "M", 128, 128, "M", 256, 256, 256, 256, "M", 512, 512, 512, 512,
+          "M", 512, 512, 512, 512, "M"),
+}
+
+
+class VGG(nn.Module):
+    """VGG trunk + CIFAR classifier head (vgg.py:15-43)."""
+
+    cfg: Sequence[Union[int, str]]
+    batch_norm: bool = False
+    num_classes: int = 10
+    dtype: Any = jnp.float32
+    bn_axis_name: Optional[str] = None
+
+    @nn.compact
+    def __call__(self, x, train: bool = False):
+        x = x.astype(self.dtype)
+        for v in self.cfg:
+            if v == "M":
+                x = nn.max_pool(x, (2, 2), strides=(2, 2))
+            else:
+                x = nn.Conv(
+                    int(v), (3, 3), padding=1, dtype=self.dtype,
+                    kernel_init=he_normal, bias_init=nn.initializers.zeros,
+                )(x)
+                if self.batch_norm:
+                    x = batch_norm(
+                        train=train, dtype=self.dtype, bn_axis_name=self.bn_axis_name
+                    )(x)
+                x = nn.relu(x)
+        x = x.reshape((x.shape[0], -1))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
+        x = nn.Dropout(0.5, deterministic=not train)(x)
+        x = nn.relu(nn.Dense(512, dtype=self.dtype)(x))
+        x = nn.Dense(self.num_classes, dtype=self.dtype)(x)
+        return x.astype(jnp.float32)
+
+
+def _vgg(cfg_key: str, batch_norm: bool, num_classes: int, **kw) -> VGG:
+    return VGG(cfg=CFGS[cfg_key], batch_norm=batch_norm, num_classes=num_classes, **kw)
+
+
+def vgg11(num_classes: int = 10, **kw):
+    return _vgg("A", False, num_classes, **kw)
+
+
+def vgg11_bn(num_classes: int = 10, **kw):
+    return _vgg("A", True, num_classes, **kw)
+
+
+def vgg13(num_classes: int = 10, **kw):
+    return _vgg("B", False, num_classes, **kw)
+
+
+def vgg13_bn(num_classes: int = 10, **kw):
+    return _vgg("B", True, num_classes, **kw)
+
+
+def vgg16(num_classes: int = 10, **kw):
+    return _vgg("D", False, num_classes, **kw)
+
+
+def vgg16_bn(num_classes: int = 10, **kw):
+    return _vgg("D", True, num_classes, **kw)
+
+
+def vgg19(num_classes: int = 10, **kw):
+    return _vgg("E", False, num_classes, **kw)
+
+
+def vgg19_bn(num_classes: int = 10, **kw):
+    return _vgg("E", True, num_classes, **kw)

@@ -1,0 +1,4 @@
+"""Ops: losses/metrics, and (see quantize.py) the int8 gradient-compression
+kernels that replace the reference's Blosc codec (src/compression.py)."""
+
+from .metrics import accuracy, cross_entropy_loss

@@ -1,0 +1,35 @@
+"""Loss and metric ops (pure jnp, jit-friendly).
+
+Parity targets: the reference computes CrossEntropyLoss
+(distributed_worker.py:96, nn_ops.py) and Prec@1/Prec@5 — implemented three
+separate times in the reference (nn_ops.py:14-27, sync_replicas_master_nn.py:33-46,
+distributed_worker.py:26-38); here, once.
+"""
+
+from __future__ import annotations
+
+from typing import Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
+    """Softmax cross-entropy with integer labels, mean reduction
+    (= torch.nn.CrossEntropyLoss)."""
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, labels[:, None], axis=-1)[:, 0]
+    return jnp.mean(nll)
+
+
+def accuracy(
+    logits: jax.Array, labels: jax.Array, topk: Sequence[int] = (1,)
+) -> Tuple[jax.Array, ...]:
+    """Prec@k for each k, in percent (parity: nn_ops.py:14-27)."""
+    maxk = max(topk)
+    _, pred = jax.lax.top_k(logits, maxk)  # [B, maxk]
+    correct = pred == labels[:, None]
+    out = []
+    for k in topk:
+        out.append(100.0 * jnp.mean(jnp.any(correct[:, :k], axis=-1)))
+    return tuple(out)

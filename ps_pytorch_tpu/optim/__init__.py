@@ -1,0 +1,48 @@
+"""Optimizers with PyTorch update semantics (reference: src/optim/).
+
+`build_optimizer` mirrors the reference's optimizer wiring: the PS constructs
+`SGD(model.parameters(), lr, momentum)` (sync_replicas_master_nn.py:122-123)
+and workers use torch.optim.SGD (distributed_worker.py:97); Adam/AMSGrad is the
+in-tree alternative (src/optim/adam.py).
+"""
+
+from __future__ import annotations
+
+import optax
+
+from .adam import AdamState, adam
+from .sgd import SGDState, sgd
+
+OPTIMIZER_REGISTRY = ("sgd", "adam", "amsgrad")
+
+
+def build_optimizer(
+    name: str,
+    learning_rate,
+    momentum: float = 0.9,
+    dampening: float = 0.0,
+    weight_decay: float = 0.0,
+    nesterov: bool = False,
+    b1: float = 0.9,
+    b2: float = 0.999,
+    eps: float = 1e-8,
+) -> optax.GradientTransformation:
+    name = name.lower()
+    if name == "sgd":
+        return sgd(
+            learning_rate,
+            momentum=momentum,
+            dampening=dampening,
+            weight_decay=weight_decay,
+            nesterov=nesterov,
+        )
+    if name in ("adam", "amsgrad"):
+        return adam(
+            learning_rate,
+            b1=b1,
+            b2=b2,
+            eps=eps,
+            weight_decay=weight_decay,
+            amsgrad=(name == "amsgrad"),
+        )
+    raise ValueError(f"unknown optimizer {name!r}; choose from {OPTIMIZER_REGISTRY}")

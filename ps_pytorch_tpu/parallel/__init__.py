@@ -1,0 +1,27 @@
+"""Parallelism layer: device mesh (replaces the MPI star topology),
+PS data-parallel engine (replaces master/worker runtimes), and
+aggregation collectives (replace the Irecv/waitany/Blosc gather path)."""
+
+from .collectives import (
+    aggregate_gradients,
+    aggregation_mask,
+    psum_mean,
+    quantized_psum,
+)
+from .mesh import (
+    WORKER_AXIS,
+    batch_sharding,
+    initialize_multihost,
+    make_mesh,
+    replicated_sharding,
+)
+from .ps import (
+    PSConfig,
+    PSTrainState,
+    init_ps_state,
+    make_ps_eval_step,
+    make_ps_train_step,
+    shard_batch,
+    shard_state,
+    state_specs,
+)

@@ -1,0 +1,69 @@
+"""Device-mesh construction — the TPU-native replacement for the reference's
+MPI star topology (mpirun + hostfile, /root/reference/src/run_pytorch.sh:1-16,
+tools/pytorch_ec2.py).
+
+One mesh axis, `workers`, plays the role of the reference's MPI worker ranks;
+the parameter server is not a separate rank but a *protocol* over the mesh
+(see parallel/ps.py): params replicated (the "bcast"), gradients psum'd (the
+"gather+aggregate"), optimizer state replicated or sharded (the "PS chip",
+generalized). Multi-host extends the same axis over DCN via jax.distributed.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+WORKER_AXIS = "workers"
+
+
+def make_mesh(
+    num_workers: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+    axis_name: str = WORKER_AXIS,
+) -> Mesh:
+    """Build a 1-D mesh of `num_workers` devices (default: all devices).
+
+    Unlike the reference — where cluster size is fixed at mpirun time by the
+    hostfile (run_pytorch.sh:1) — the same process can carve any leading
+    subset of visible chips into a worker mesh.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n = num_workers or len(devs)
+    if n > len(devs):
+        raise ValueError(f"requested {n} workers but only {len(devs)} devices")
+    return Mesh(np.array(devs[:n]), (axis_name,))
+
+
+def batch_sharding(mesh: Mesh, axis_name: str = WORKER_AXIS) -> NamedSharding:
+    """Sharding for a global batch: split along the leading (batch) dim."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def replicated_sharding(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def worker_stacked_sharding(mesh: Mesh, axis_name: str = WORKER_AXIS) -> NamedSharding:
+    """Sharding for per-worker state stacked on a leading axis of size
+    num_workers (used for `bn_mode='local'` BatchNorm stats)."""
+    return NamedSharding(mesh, P(axis_name))
+
+
+def initialize_multihost(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> None:
+    """Join a multi-host training job over DCN (replaces mpirun's process
+    spawn + rendezvous, run_pytorch.sh:1). No-op for single-process runs."""
+    if coordinator_address is None:
+        return
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )

@@ -1,0 +1,333 @@
+"""The parameter-server data-parallel engine — shard_map over a device mesh.
+
+This is the TPU-native re-design of the reference's L4 scheduler layer
+(SURVEY.md sections 1-3): `SyncReplicasMaster_NN.start()`'s bcast/gather/
+aggregate/step loop (sync_replicas_master_nn.py:133-197) and
+`DistributedWorker.train()`'s fetch/forward/backward/send loop
+(distributed_worker.py:104-180) collapse into ONE jitted SPMD step:
+
+  reference protocol                      this engine
+  ------------------------------------    -----------------------------------
+  master bcasts step (tag 10)             XLA synchronous dispatch (implicit)
+  master bcasts weights per layer         params replicated on the mesh
+  worker forward/backward                 per-shard value_and_grad
+  worker per-layer Isend (tag 88+l)       lax.psum / psum_scatter over ICI
+  master waitany + partial aggregate      aggregation_mask + psum (collectives)
+  master in-tree SGD step / num_agg       optax update, replicated or ZeRO-1
+  worker BN stats stay local              bn_mode = local | pmean | synced
+  Blosc codec                             int8 quantized collective (Pallas)
+
+Optimizer placement ("where does the PS live"):
+- "replicated": every chip applies the identical update — mathematically the
+  reference's PS update broadcast to everyone, with zero extra comm.
+- "sharded": ZeRO-1-style — gradients reduce_scatter to 1/N shards, each chip
+  updates its shard of optimizer state, params all_gather back. This IS the
+  parameter server, sharded across the mesh instead of parked on rank 0
+  (and it cuts optimizer memory + aggregate bandwidth vs. the star topology).
+
+BatchNorm modes (reference keeps per-worker BN stats and never syncs them —
+distributed_worker.py:239-252):
+- "local":  strict parity — stats stored per worker (stacked leading axis).
+- "pmean":  stats averaged across workers each step (sane default).
+- "synced": cross-replica BN (build the model with bn_axis_name=axis).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional
+
+import flax.struct
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+from jax.flatten_util import ravel_pytree
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models import apply_model
+from ..ops.metrics import accuracy, cross_entropy_loss
+from ..ops.quantize import dequantize_int8, quantize_int8
+from .collectives import aggregate_gradients, aggregation_mask
+from .mesh import WORKER_AXIS
+
+tree_map = jax.tree_util.tree_map
+
+
+@dataclasses.dataclass(frozen=True)
+class PSConfig:
+    """Knobs mirroring the reference CLI (distributed_nn.py:24-68) plus the
+    TPU-native extensions. `num_aggregate` <-> --num-aggregate; `compress`
+    <-> --compress-grad; `mask_mode='random_k'` emulates aggregating the
+    first K gradients to *arrive* (arrival order is nondeterministic)."""
+
+    num_workers: int
+    axis_name: str = WORKER_AXIS
+    num_aggregate: Optional[int] = None
+    mask_mode: str = "random_k"
+    compress: Optional[str] = None  # None | "int8"
+    quant_block_size: int = 0
+    opt_placement: str = "replicated"  # "replicated" | "sharded"
+    bn_mode: str = "pmean"  # "local" | "pmean" | "synced"
+
+    def __post_init__(self):
+        if self.opt_placement not in ("replicated", "sharded"):
+            raise ValueError(f"bad opt_placement {self.opt_placement!r}")
+        if self.bn_mode not in ("local", "pmean", "synced"):
+            raise ValueError(f"bad bn_mode {self.bn_mode!r}")
+        if self.compress not in (None, "none", "int8"):
+            raise ValueError(f"bad compress {self.compress!r}")
+
+    @property
+    def effective_aggregate(self) -> int:
+        if self.num_aggregate is None or self.num_aggregate >= self.num_workers:
+            return self.num_workers
+        return self.num_aggregate
+
+
+@flax.struct.dataclass
+class PSTrainState:
+    step: jax.Array
+    params: Any
+    opt_state: Any
+    batch_stats: Any
+
+
+def _flat_padded_size(params) -> int:
+    return sum(int(jnp.size(p)) for p in jax.tree_util.tree_leaves(params))
+
+
+def init_ps_state(
+    model,
+    tx: optax.GradientTransformation,
+    cfg: PSConfig,
+    rng: jax.Array,
+    input_shape,
+) -> PSTrainState:
+    """Build the (host-side) initial state with the stacking layout the
+    engine expects for the configured placement/bn modes."""
+    from ..models import init_model
+
+    params, batch_stats = init_model(model, rng, input_shape)
+    if cfg.opt_placement == "sharded":
+        total = _flat_padded_size(params)
+        shard = -(-total // cfg.num_workers)
+        flat_zeros = jnp.zeros((shard,), jnp.float32)
+        one_state = tx.init(flat_zeros)
+        # identical zero-init on every worker; stacked leading axis = worker
+        opt_state = tree_map(
+            lambda x: jnp.broadcast_to(x, (cfg.num_workers,) + jnp.shape(x)), one_state
+        )
+    else:
+        opt_state = tx.init(params)
+    if cfg.bn_mode == "local" and batch_stats:
+        batch_stats = tree_map(
+            lambda x: jnp.broadcast_to(x, (cfg.num_workers,) + x.shape), batch_stats
+        )
+    return PSTrainState(
+        step=jnp.zeros([], jnp.int32),
+        params=params,
+        opt_state=opt_state,
+        batch_stats=batch_stats,
+    )
+
+
+def state_specs(cfg: PSConfig):
+    """PartitionSpecs (pytree prefixes) for PSTrainState components."""
+    opt_spec = P(cfg.axis_name) if cfg.opt_placement == "sharded" else P()
+    bs_spec = P(cfg.axis_name) if cfg.bn_mode == "local" else P()
+    return PSTrainState(step=P(), params=P(), opt_state=opt_spec, batch_stats=bs_spec)
+
+
+def shard_state(state: PSTrainState, mesh: Mesh, cfg: PSConfig) -> PSTrainState:
+    """Place a host-built state onto the mesh with the right shardings."""
+    specs = state_specs(cfg)
+
+    def put(tree, spec):
+        return tree_map(lambda x: jax.device_put(x, NamedSharding(mesh, spec)), tree)
+
+    return PSTrainState(
+        step=put(state.step, P()),
+        params=put(state.params, specs.params),
+        opt_state=put(state.opt_state, specs.opt_state),
+        batch_stats=put(state.batch_stats, specs.batch_stats),
+    )
+
+
+def shard_batch(batch, mesh: Mesh, cfg: PSConfig):
+    """Split the global batch across workers (leading dim)."""
+    return jax.device_put(batch, NamedSharding(mesh, P(cfg.axis_name)))
+
+
+def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key):
+    """ZeRO-1 "sharded PS": mask -> (quantize) -> reduce_scatter -> per-shard
+    optax update -> all_gather the parameter delta."""
+    axis, n = cfg.axis_name, cfg.num_workers
+    k = cfg.effective_aggregate
+    if k != n:
+        sel = aggregation_mask(axis, n, cfg.num_aggregate, mask_key, cfg.mask_mode)
+        grads = tree_map(lambda g: g * sel.astype(g.dtype), grads)
+    flat_g, unravel = ravel_pytree(grads)
+    total = flat_g.shape[0]
+    shard = -(-total // n)
+    if cfg.compress == "int8" and cfg.quant_block_size:
+        # keep shards block-aligned so scattered slices own whole scale rows
+        b = cfg.quant_block_size
+        shard = -(-shard // b) * b
+    flat_g = jnp.pad(flat_g.astype(jnp.float32), (0, shard * n - total))
+    if cfg.compress == "int8":
+        q, scale = quantize_int8(flat_g, axis_name=axis, block_size=cfg.quant_block_size)
+        if cfg.quant_block_size:
+            # per-block scales: scatter blocks, keep scale rows aligned
+            qflat = q.reshape(-1)
+            s = lax.psum_scatter(qflat.astype(jnp.int32), axis, tiled=True)
+            nb_shard = s.shape[0] // cfg.quant_block_size
+            w = lax.axis_index(axis)
+            scale_shard = lax.dynamic_slice(scale, (w * nb_shard, 0), (nb_shard, 1))
+            g_shard = (
+                s.reshape(nb_shard, cfg.quant_block_size).astype(jnp.float32)
+                * scale_shard
+            ).reshape(-1) / k
+        else:
+            s = lax.psum_scatter(q.astype(jnp.int32), axis, tiled=True)
+            g_shard = dequantize_int8(s, scale) / k
+    else:
+        g_shard = lax.psum_scatter(flat_g, axis, tiled=True) / k
+    flat_p, _ = ravel_pytree(params)
+    flat_p = jnp.pad(flat_p.astype(jnp.float32), (0, shard * n - total))
+    w = lax.axis_index(axis)
+    p_shard = lax.dynamic_slice(flat_p, (w * shard,), (shard,))
+    upd_shard, new_opt = tx.update(g_shard, opt_state, p_shard)
+    upd_full = lax.all_gather(upd_shard, axis, tiled=True)[:total]
+    new_params = optax.apply_updates(params, unravel(upd_full))
+    return new_params, new_opt
+
+
+def make_ps_train_step(
+    model,
+    tx: optax.GradientTransformation,
+    cfg: PSConfig,
+    mesh: Mesh,
+    preprocess: Optional[Callable[[jax.Array, jax.Array], jax.Array]] = None,
+    donate: bool = True,
+):
+    """Build the jitted SPMD train step: (state, batch, key) -> (state, metrics).
+
+    `batch` is {"image": uint8 [B,...], "label": int32 [B]} with B divisible by
+    num_workers; `key` drives augmentation/dropout (per-worker folded) and the
+    random-K aggregation mask (shared). One call = one global step of the
+    reference protocol (master step N + all workers' iteration N together).
+    """
+    axis, n = cfg.axis_name, cfg.num_workers
+    specs = state_specs(cfg)
+
+    def worker_fn(step_idx, params, opt_state, batch_stats, images, labels, key):
+        w = lax.axis_index(axis)
+        k_step = jax.random.fold_in(key, step_idx)
+        k_mask = jax.random.fold_in(k_step, 0xA66)
+        k_aug, k_drop = jax.random.split(jax.random.fold_in(k_step, w + 1))
+
+        x = preprocess(k_aug, images) if preprocess else images.astype(jnp.float32)
+
+        if cfg.opt_placement == "sharded":
+            opt_state = tree_map(lambda a: a[0], opt_state)
+        bs = tree_map(lambda a: a[0], batch_stats) if cfg.bn_mode == "local" else batch_stats
+
+        def loss_fn(p):
+            logits, new_bs = apply_model(model, p, bs, x, train=True, dropout_rng=k_drop)
+            return cross_entropy_loss(logits, labels), (logits, new_bs)
+
+        (loss, (logits, new_bs)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
+            params
+        )
+
+        if cfg.opt_placement == "sharded":
+            params, new_opt = _sharded_ps_update(params, opt_state, grads, tx, cfg, k_mask)
+            new_opt = tree_map(lambda a: a[None], new_opt)
+        else:
+            agg = aggregate_gradients(
+                grads,
+                axis,
+                n,
+                num_aggregate=cfg.num_aggregate,
+                mask_key=k_mask,
+                mask_mode=cfg.mask_mode,
+                compress=cfg.compress,
+                quant_block_size=cfg.quant_block_size,
+            )
+            updates, new_opt = tx.update(agg, opt_state, params)
+            params = optax.apply_updates(params, updates)
+
+        if cfg.bn_mode == "local":
+            out_bs = tree_map(lambda a: a[None], new_bs)
+        else:
+            out_bs = lax.pmean(new_bs, axis) if new_bs else new_bs
+
+        prec1, prec5 = accuracy(logits, labels, (1, 5))
+        metrics = lax.pmean(
+            {"loss": loss, "prec1": prec1, "prec5": prec5}, axis
+        )
+        return params, new_opt, out_bs, metrics
+
+    mapped = jax.shard_map(
+        worker_fn,
+        mesh=mesh,
+        in_specs=(
+            P(),
+            specs.params,
+            specs.opt_state,
+            specs.batch_stats,
+            P(axis),
+            P(axis),
+            P(),
+        ),
+        out_specs=(specs.params, specs.opt_state, specs.batch_stats, P()),
+        check_vma=False,
+    )
+
+    def step(state: PSTrainState, batch, key):
+        params, opt_state, batch_stats, metrics = mapped(
+            state.step,
+            state.params,
+            state.opt_state,
+            state.batch_stats,
+            batch["image"],
+            batch["label"],
+            key,
+        )
+        new_state = PSTrainState(
+            step=state.step + 1,
+            params=params,
+            opt_state=opt_state,
+            batch_stats=batch_stats,
+        )
+        return new_state, metrics
+
+    return jax.jit(step, donate_argnums=(0,) if donate else ())
+
+
+def make_ps_eval_step(model, cfg: PSConfig, mesh: Mesh, preprocess=None):
+    """Sharded evaluation step: (state, batch) -> metrics (pmean'd)."""
+    axis = cfg.axis_name
+
+    def worker_fn(params, batch_stats, images, labels):
+        bs = tree_map(lambda a: a[0], batch_stats) if cfg.bn_mode == "local" else batch_stats
+        x = preprocess(None, images) if preprocess else images.astype(jnp.float32)
+        logits, _ = apply_model(model, params, bs, x, train=False)
+        loss = cross_entropy_loss(logits, labels)
+        prec1, prec5 = accuracy(logits, labels, (1, 5))
+        return lax.pmean({"loss": loss, "prec1": prec1, "prec5": prec5}, axis)
+
+    specs = state_specs(cfg)
+    mapped = jax.shard_map(
+        worker_fn,
+        mesh=mesh,
+        in_specs=(specs.params, specs.batch_stats, P(axis), P(axis)),
+        out_specs=P(),
+        check_vma=False,
+    )
+
+    def step(state: PSTrainState, batch):
+        return mapped(state.params, state.batch_stats, batch["image"], batch["label"])
+
+    return jax.jit(step)

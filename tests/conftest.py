@@ -1,0 +1,33 @@
+"""Test fixtures: force an 8-device virtual CPU platform BEFORE jax imports,
+so the full PS protocol runs single-process on a fake mesh
+(SURVEY.md section 4 implication; the reference has no test suite at all).
+"""
+
+import os
+
+# Force CPU: the ambient environment sets JAX_PLATFORMS=axon (one real TPU
+# chip); concurrent test processes would serialize on the chip lock, and the
+# 8-device virtual mesh only exists on the CPU platform.
+os.environ["JAX_PLATFORMS"] = "cpu"
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import jax  # noqa: E402
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def devices():
+    devs = jax.devices()
+    assert len(devs) == 8, f"expected 8 virtual CPU devices, got {devs}"
+    return devs
+
+
+@pytest.fixture(scope="session")
+def mesh(devices):
+    from ps_pytorch_tpu.parallel.mesh import make_mesh
+
+    return make_mesh(num_workers=8)

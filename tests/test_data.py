@@ -1,0 +1,121 @@
+"""Data layer tests: synthetic datasets, normalization parity, augmentation
+shape/determinism, loader epoch semantics, worker sharding."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ps_pytorch_tpu.data import (
+    BatchIterator,
+    Dataset,
+    make_preprocessor,
+    make_synthetic,
+    normalize,
+    prefetch_to_device,
+    prepare_data,
+    random_crop_flip,
+    shard_for_worker,
+)
+from ps_pytorch_tpu.data.datasets import NORM_STATS, NUM_CLASSES
+
+
+@pytest.mark.parametrize("name", ["MNIST", "Cifar10", "Cifar100", "SVHN"])
+def test_synthetic_datasets(name):
+    ds = make_synthetic(name, train_size=256, test_size=64)
+    assert ds.synthetic
+    assert ds.train_images.dtype == np.uint8
+    assert ds.train_labels.dtype == np.int32
+    assert ds.train_images.shape[0] == 256
+    assert ds.num_classes == NUM_CLASSES[name]
+    assert ds.train_labels.max() < ds.num_classes
+
+
+def test_prepare_data_falls_back_to_synthetic(tmp_path):
+    ds = prepare_data("Cifar10", root=str(tmp_path))
+    assert ds.synthetic
+
+
+def test_prepare_data_unknown_name():
+    with pytest.raises(ValueError):
+        prepare_data("ImageNet")
+
+
+def test_prepare_data_no_synthetic_raises(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        prepare_data("MNIST", root=str(tmp_path), allow_synthetic=False)
+
+
+def test_normalize_matches_reference_constants():
+    mean, std = NORM_STATS["Cifar10"]
+    x = np.full((1, 2, 2, 3), 128, np.uint8)
+    out = np.asarray(normalize(jnp.asarray(x), mean, std))
+    expected = (128 / 255.0 - mean) / std
+    np.testing.assert_allclose(out[0, 0, 0], expected, rtol=1e-5)
+
+
+def test_random_crop_flip_shapes_and_determinism():
+    x = jnp.asarray(np.random.RandomState(0).randint(0, 255, (8, 32, 32, 3), np.uint8))
+    a = random_crop_flip(jax.random.key(7), x)
+    b = random_crop_flip(jax.random.key(7), x)
+    c = random_crop_flip(jax.random.key(8), x)
+    assert a.shape == x.shape
+    assert jnp.array_equal(a, b)
+    assert not jnp.array_equal(a, c)
+
+
+def test_preprocessor_train_vs_eval():
+    ds = make_synthetic("Cifar10", train_size=64, test_size=16)
+    x = jnp.asarray(ds.train_images[:4])
+    train_fn = make_preprocessor("Cifar10", train=True)
+    eval_fn = make_preprocessor("Cifar10", train=False)
+    t1 = train_fn(jax.random.key(0), x)
+    t2 = train_fn(jax.random.key(1), x)
+    e1 = eval_fn(jax.random.key(0), x)
+    e2 = eval_fn(jax.random.key(1), x)
+    assert not jnp.array_equal(t1, t2)  # train path is stochastic
+    assert jnp.array_equal(e1, e2)  # eval path ignores the key
+    assert t1.dtype == jnp.float32
+
+
+def test_batch_iterator_epoch():
+    ds = make_synthetic("MNIST", train_size=100, test_size=10)
+    it = BatchIterator(ds.train_images, ds.train_labels, batch_size=32, seed=1)
+    batches = list(it.epoch())
+    assert len(batches) == 3  # drop_last
+    assert batches[0]["image"].shape == (32, 28, 28, 1)
+    assert batches[0]["label"].shape == (32,)
+    e1 = list(it.epoch())
+    assert not np.array_equal(batches[0]["image"], e1[0]["image"])  # reshuffled
+
+
+def test_batch_iterator_tiny_dataset_pads():
+    ds = make_synthetic("MNIST", train_size=8, test_size=4)
+    it = BatchIterator(ds.train_images, ds.train_labels, batch_size=32)
+    batches = list(it.epoch())
+    assert len(batches) == 1
+    assert batches[0]["image"].shape[0] == 32
+
+
+def test_shard_for_worker_modes():
+    ds = make_synthetic("MNIST", train_size=128, test_size=8)
+    # reshuffle: full data, distinct seeds
+    x0, y0, s0 = shard_for_worker(ds.train_images, ds.train_labels, 0, 4)
+    x1, y1, s1 = shard_for_worker(ds.train_images, ds.train_labels, 1, 4)
+    assert len(x0) == len(x1) == 128 and s0 != s1
+    # disjoint: true partition
+    xs = [
+        shard_for_worker(ds.train_images, ds.train_labels, w, 4, mode="disjoint")[0]
+        for w in range(4)
+    ]
+    assert all(len(x) == 32 for x in xs)
+    with pytest.raises(ValueError):
+        shard_for_worker(ds.train_images, ds.train_labels, 0, 4, mode="bogus")
+
+
+def test_prefetch_to_device():
+    ds = make_synthetic("MNIST", train_size=64, test_size=8)
+    it = BatchIterator(ds.train_images, ds.train_labels, batch_size=16)
+    out = list(prefetch_to_device(it.epoch()))
+    assert len(out) == 4
+    assert isinstance(out[0]["image"], jax.Array)

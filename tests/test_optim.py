@@ -1,0 +1,113 @@
+"""Optimizer parity tests: our optax transforms vs torch.optim (CPU torch is
+the ground truth for the reference's PyTorch update semantics —
+src/optim/sgd.py:59-92 and src/optim/adam.py:38-95 mirror torch's updates).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+import pytest
+import torch
+
+from ps_pytorch_tpu.optim import adam, build_optimizer, sgd
+
+
+def _run_jax(tx, grads_seq, p0):
+    params = {"w": jnp.asarray(p0)}
+    state = tx.init(params)
+    for g in grads_seq:
+        updates, state = tx.update({"w": jnp.asarray(g)}, state, params)
+        params = optax.apply_updates(params, updates)
+    return np.asarray(params["w"])
+
+
+def _run_torch(opt_ctor, grads_seq, p0):
+    p = torch.nn.Parameter(torch.tensor(p0))
+    opt = opt_ctor([p])
+    for g in grads_seq:
+        opt.zero_grad()
+        p.grad = torch.tensor(g)
+        opt.step()
+    return p.detach().numpy()
+
+
+P0 = np.array([1.0, -2.0, 3.0], np.float32)
+GRADS = [
+    np.array([0.1, -0.2, 0.3], np.float32),
+    np.array([-0.05, 0.4, 0.2], np.float32),
+    np.array([0.7, 0.0, -0.1], np.float32),
+    np.array([0.02, 0.03, 0.9], np.float32),
+]
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(momentum=0.0),
+        dict(momentum=0.9),
+        dict(momentum=0.9, dampening=0.5),
+        dict(momentum=0.9, weight_decay=1e-2),
+        dict(momentum=0.9, nesterov=True),
+    ],
+)
+def test_sgd_matches_torch(kw):
+    ours = _run_jax(sgd(0.1, **kw), GRADS, P0)
+    ref = _run_torch(lambda ps: torch.optim.SGD(ps, lr=0.1, **kw), GRADS, P0)
+    np.testing.assert_allclose(ours, ref, rtol=1e-6, atol=1e-7)
+
+
+@pytest.mark.parametrize(
+    "kw",
+    [
+        dict(),
+        dict(weight_decay=1e-2),
+        dict(amsgrad=True),
+        dict(b1=0.8, b2=0.99, eps=1e-6),
+    ],
+)
+def test_adam_matches_torch(kw):
+    tkw = dict(kw)
+    if "b1" in tkw:
+        tkw["betas"] = (tkw.pop("b1"), tkw.pop("b2"))
+    ours = _run_jax(adam(1e-2, **kw), GRADS, P0)
+    ref = _run_torch(lambda ps: torch.optim.Adam(ps, lr=1e-2, **tkw), GRADS, P0)
+    np.testing.assert_allclose(ours, ref, rtol=1e-5, atol=1e-7)
+
+
+def test_sgd_lr_schedule():
+    sched = lambda count: 0.1 * (0.5 ** (count // 2))
+    ours = _run_jax(sgd(sched), GRADS, P0)
+    expected = P0.copy()
+    for i, g in enumerate(GRADS):
+        expected = expected - (0.1 * 0.5 ** (i // 2)) * g
+    np.testing.assert_allclose(ours, expected, rtol=1e-6)
+
+
+def test_nesterov_requires_momentum():
+    with pytest.raises(ValueError):
+        sgd(0.1, nesterov=True)
+    with pytest.raises(ValueError):
+        sgd(0.1, momentum=0.9, dampening=0.1, nesterov=True)
+
+
+def test_build_optimizer_registry():
+    assert build_optimizer("sgd", 0.1) is not None
+    assert build_optimizer("adam", 1e-3) is not None
+    assert build_optimizer("amsgrad", 1e-3) is not None
+    with pytest.raises(ValueError):
+        build_optimizer("lars", 0.1)
+
+
+def test_optimizers_are_jittable():
+    tx = sgd(0.1, momentum=0.9, nesterov=True)
+    params = {"w": jnp.ones((4,))}
+    state = tx.init(params)
+
+    @jax.jit
+    def step(params, state, g):
+        updates, state = tx.update(g, state, params)
+        return optax.apply_updates(params, updates), state
+
+    params, state = step(params, state, {"w": jnp.ones((4,))})
+    assert params["w"].shape == (4,)

@@ -1,0 +1,70 @@
+"""Quantization tests: round-trip error bounds, zero/edge handling, block mode,
+and golden values (SURVEY.md section 4: golden-value tests of quantize/dequantize)."""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from ps_pytorch_tpu.ops.quantize import (
+    dequantize_int8,
+    quantization_error,
+    quantize_int8,
+)
+
+
+def test_round_trip_error_bound():
+    x = jax.random.normal(jax.random.key(0), (1000,)) * 3.0
+    q, s = quantize_int8(x)
+    err = np.asarray(jnp.abs(dequantize_int8(q, s) - x))
+    # symmetric absmax quantization: |err| <= scale/2
+    assert err.max() <= float(s) / 2 + 1e-7
+    assert q.dtype == jnp.int8
+
+
+def test_golden_values():
+    x = jnp.asarray([0.0, 1.0, -1.0, 0.5, -0.25])
+    q, s = quantize_int8(x)
+    np.testing.assert_array_equal(np.asarray(q), [0, 127, -127, 64, -32])
+    assert float(s) == pytest.approx(1.0 / 127.0)
+
+
+def test_zero_tensor():
+    q, s = quantize_int8(jnp.zeros((64,)))
+    assert np.all(np.asarray(q) == 0)
+    assert float(s) == 0.0
+    assert np.all(np.asarray(dequantize_int8(q, s)) == 0.0)
+
+
+def test_block_mode_tighter_than_per_tensor():
+    # one huge outlier ruins a per-tensor scale; block scales localize it
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(np.concatenate([rng.normal(0, 0.01, 512), [100.0]]).astype(np.float32))
+    err_tensor = float(quantization_error(x))
+    err_block = float(quantization_error(x, block_size=128))
+    assert err_block < err_tensor
+
+
+def test_block_mode_round_trip_shape():
+    x = jax.random.normal(jax.random.key(1), (7, 13))  # deliberately unaligned
+    q, s = quantize_int8(x, block_size=32)
+    out = dequantize_int8(q, s, block_size=32, shape=x.shape)
+    assert out.shape == x.shape
+    assert float(jnp.max(jnp.abs(out - x))) < float(jnp.max(s)) / 2 + 1e-7
+
+
+def test_block_dequant_requires_shape():
+    x = jax.random.normal(jax.random.key(1), (64,))
+    q, s = quantize_int8(x, block_size=32)
+    with pytest.raises(ValueError):
+        dequantize_int8(q, s, block_size=32)
+
+
+def test_quantize_under_jit_and_grad_shapes():
+    @jax.jit
+    def f(x):
+        q, s = quantize_int8(x)
+        return dequantize_int8(q, s)
+
+    x = jax.random.normal(jax.random.key(2), (33, 65))
+    assert f(x).shape == x.shape
