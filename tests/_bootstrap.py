@@ -1,0 +1,30 @@
+"""Early pytest plugin (loaded via `addopts = -p tests._bootstrap`) that
+re-execs the interpreter into a CPU-only JAX environment.
+
+Why: the ambient environment's sitecustomize registers a TPU PJRT plugin at
+interpreter startup (gated on PALLAS_AXON_POOL_IPS). Mixing that registration
+with JAX_PLATFORMS=cpu hangs backend init, and conftest.py runs too late to
+prevent it — both the plugin registration (sitecustomize) and pytest's FD
+capture have already happened by then (an execve from conftest silently loses
+all output into pytest's capture tempfile). A `-p` plugin imports during
+command-line preparse, before capture starts, so execve here keeps the
+console FDs and comes up in a clean CPU-only interpreter.
+
+The tests need CPU with 8 virtual devices so the full PS protocol runs
+single-process on a fake mesh (SURVEY.md section 4 implication).
+"""
+
+import os
+import sys
+
+if os.environ.get("PALLAS_AXON_POOL_IPS") or os.environ.get("JAX_PLATFORMS") not in (
+    "cpu",
+    None,
+):
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env)

@@ -1,14 +1,16 @@
 """Test fixtures: force an 8-device virtual CPU platform BEFORE jax imports,
 so the full PS protocol runs single-process on a fake mesh
 (SURVEY.md section 4 implication; the reference has no test suite at all).
+
+The CPU-only environment (TPU plugin disabled, 8 virtual devices) is
+established by the early plugin `tests/_bootstrap.py` (see pytest.ini
+addopts), which re-execs the interpreter before pytest starts capturing.
+This conftest only asserts/fills the defaults for direct module runs.
 """
 
 import os
 
-# Force CPU: the ambient environment sets JAX_PLATFORMS=axon (one real TPU
-# chip); concurrent test processes would serialize on the chip lock, and the
-# 8-device virtual mesh only exists on the CPU platform.
-os.environ["JAX_PLATFORMS"] = "cpu"
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (

@@ -194,7 +194,9 @@ def test_convergence_smoke(mesh):
     ds = make_synthetic("MNIST", train_size=512, test_size=128, seed=3)
     cfg = PSConfig(num_workers=N)
     model = build_model("LeNet")
-    tx = sgd(0.05, momentum=0.9)
+    # lr 0.05 + momentum 0.9 oscillates on this synthetic set (verified
+    # identically on a single device, so it is dynamics, not an engine bug)
+    tx = sgd(0.01, momentum=0.9)
     state = init_ps_state(model, tx, cfg, jax.random.key(0), (28, 28, 1))
     state = shard_state(state, mesh, cfg)
     pre = make_preprocessor("MNIST", train=True)
