@@ -1,0 +1,80 @@
+"""Root conftest: re-exec pytest into a CPU-only JAX environment.
+
+The ambient environment's sitecustomize registers a TPU PJRT plugin at
+interpreter startup (gated on PALLAS_AXON_POOL_IPS) and jax reads
+JAX_PLATFORMS at that moment — long before any conftest runs — so backend
+selection cannot be fixed in-process; mixing the registered TPU plugin with
+a late JAX_PLATFORMS=cpu hangs backend init. The tests need CPU with 8
+virtual devices so the full PS protocol runs single-process on a fake mesh
+(SURVEY.md section 4 implication).
+
+The re-exec happens in pytest_configure, where both the original pytest
+arguments (config.invocation_params.args — correct even for programmatic
+pytest.main() callers) and the capture manager are available: suspending
+global capture first restores the original stdout/stderr file descriptors,
+so the re-exec'd run keeps its console output (an execve while FD capture
+is active would silently redirect everything into a doomed tempfile).
+
+Caveat for programmatic pytest.main() callers in a dirty environment: the
+execve replaces the calling process, so code after pytest.main() never
+runs. Pre-clean the environment (PALLAS_AXON_POOL_IPS="", JAX_PLATFORMS=
+"cpu") to keep pytest in-process.
+"""
+
+import os
+import subprocess
+import sys
+
+_REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+
+
+def _env_is_clean() -> bool:
+    return not os.environ.get("PALLAS_AXON_POOL_IPS") and os.environ.get(
+        "JAX_PLATFORMS", "cpu"
+    ) == "cpu"
+
+
+def _clean_env() -> dict:
+    env = dict(os.environ)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+    return env
+
+
+def pytest_configure(config):
+    if _env_is_clean():
+        return
+
+    # Absolutize positional test paths (node ids may carry ::selectors);
+    # option values are passed through untouched, and the cwd is preserved
+    # so relative option values (e.g. --junitxml=report.xml) still land
+    # where the caller expects.
+    args = []
+    has_positional = False
+    for a in config.invocation_params.args:
+        path, sep, rest = a.partition("::")
+        if not a.startswith("-") and os.path.exists(path):
+            a = os.path.abspath(path) + sep + rest
+            has_positional = True
+        args.append(a)
+    if not has_positional:
+        # bare invocation: the child discovers pytest.ini/testpaths from cwd
+        os.chdir(_REPO_ROOT)
+
+    capman = config.pluginmanager.getplugin("capturemanager")
+    if capman is not None:
+        capman.suspend_global_capture(in_=True)
+    os.execve(
+        sys.executable,
+        [
+            sys.executable,
+            *subprocess._args_from_interpreter_flags(),
+            "-m",
+            "pytest",
+            *args,
+        ],
+        _clean_env(),
+    )

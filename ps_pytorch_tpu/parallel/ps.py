@@ -97,6 +97,18 @@ def _flat_padded_size(params) -> int:
     return sum(int(jnp.size(p)) for p in jax.tree_util.tree_leaves(params))
 
 
+def _zero1_shard_size(total: int, cfg: PSConfig) -> int:
+    """Per-worker flat shard length for the ZeRO-1 placement. Must be
+    identical at init (optimizer-state buffers) and in the update step;
+    with block-quantized int8 collectives the shard is rounded up so each
+    scattered slice owns whole quantization-scale rows."""
+    shard = -(-total // cfg.num_workers)
+    if cfg.compress == "int8" and cfg.quant_block_size:
+        b = cfg.quant_block_size
+        shard = -(-shard // b) * b
+    return shard
+
+
 def init_ps_state(
     model,
     tx: optax.GradientTransformation,
@@ -111,7 +123,7 @@ def init_ps_state(
     params, batch_stats = init_model(model, rng, input_shape)
     if cfg.opt_placement == "sharded":
         total = _flat_padded_size(params)
-        shard = -(-total // cfg.num_workers)
+        shard = _zero1_shard_size(total, cfg)
         flat_zeros = jnp.zeros((shard,), jnp.float32)
         one_state = tx.init(flat_zeros)
         # identical zero-init on every worker; stacked leading axis = worker
@@ -169,11 +181,7 @@ def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key):
         grads = tree_map(lambda g: g * sel.astype(g.dtype), grads)
     flat_g, unravel = ravel_pytree(grads)
     total = flat_g.shape[0]
-    shard = -(-total // n)
-    if cfg.compress == "int8" and cfg.quant_block_size:
-        # keep shards block-aligned so scattered slices own whole scale rows
-        b = cfg.quant_block_size
-        shard = -(-shard // b) * b
+    shard = _zero1_shard_size(total, cfg)
     flat_g = jnp.pad(flat_g.astype(jnp.float32), (0, shard * n - total))
     if cfg.compress == "int8":
         q, scale = quantize_int8(flat_g, axis_name=axis, block_size=cfg.quant_block_size)

@@ -3,14 +3,15 @@ so the full PS protocol runs single-process on a fake mesh
 (SURVEY.md section 4 implication; the reference has no test suite at all).
 
 The CPU-only environment (TPU plugin disabled, 8 virtual devices) is
-established by the early plugin `tests/_bootstrap.py` (see pytest.ini
-addopts), which re-execs the interpreter before pytest starts capturing.
-This conftest only asserts/fills the defaults for direct module runs.
+established by the root conftest.py, which re-execs pytest with a clean
+environment from pytest_configure (after restoring the captured FDs).
+This file only forces the defaults again as defense in depth for direct
+module runs and for invocations where the root conftest did not load.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
