@@ -48,15 +48,18 @@ def pytest_configure(config):
     if _env_is_clean():
         return
 
-    # Absolutize positional test paths (node ids may carry ::selectors);
-    # option values are passed through untouched, and the cwd is preserved
-    # so relative option values (e.g. --junitxml=report.xml) still land
-    # where the caller expects.
+    # Absolutize positional test paths (node ids may carry ::selectors).
+    # Only rewrite tokens pytest itself parsed as positionals (config.args),
+    # so option values that happen to name existing paths (-k tests) are
+    # passed through untouched; the cwd is preserved so relative option
+    # values (e.g. --junitxml=report.xml) still land where the caller
+    # expects.
+    positionals = set(config.args)
     args = []
     has_positional = False
     for a in config.invocation_params.args:
         path, sep, rest = a.partition("::")
-        if not a.startswith("-") and os.path.exists(path):
+        if a in positionals and not a.startswith("-") and os.path.exists(path):
             a = os.path.abspath(path) + sep + rest
             has_positional = True
         args.append(a)

@@ -1,0 +1,11 @@
+"""Command-line entry points, mirroring the reference's process surface:
+
+- ``python -m ps_pytorch_tpu.cli.train``          <- src/distributed_nn.py
+- ``python -m ps_pytorch_tpu.cli.single_machine`` <- src/single_machine.py
+- ``python -m ps_pytorch_tpu.cli.evaluate``       <- src/distributed_evaluator.py
+- ``python -m ps_pytorch_tpu.cli.tune``           <- src/tune.sh + tiny_tuning_parser.py
+
+One process drives the whole mesh (no mpirun); `--num-workers` replaces the
+hostfile/world-size, and multi-host pods join via --coordinator-address
+(jax.distributed over DCN).
+"""

@@ -1,0 +1,119 @@
+"""Shared argparse surface, mirroring the reference flag names and defaults
+(/root/reference/src/distributed_nn.py:24-68, distributed_evaluator.py:39-56,
+single_machine.py:24-51) plus the TPU-native extensions.
+
+Deliberate mappings (documented divergences):
+- --compress-grad compress|none  -> int8-quantized collectives (Blosc is a
+  host-byte codec; on an ICI reduce path the bandwidth lever is quantization.
+  The C++ host codec used for checkpoints lives in native/, see ops/codec.py).
+- --enable-gpu                    -> accepted, ignored (accelerator selection
+  is JAX_PLATFORMS; the reference's type=bool flag was itself broken — any
+  non-empty string was True, distributed_nn.py:66).
+- --mode/--kill-threshold         -> accepted; straggler kill is meaningless
+  under synchronous SPMD dispatch (no stragglers intra-slice); the capability
+  it bought — stepping on a subset of gradients — is --num-aggregate.
+- --comm-type Bcast|Async         -> accepted, ignored (weights live
+  replicated on the mesh; there is nothing to fetch).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+from ..parallel import PSConfig
+from ..trainer import TrainConfig
+
+
+def add_train_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    d = TrainConfig()
+    parser.add_argument("--batch-size", type=int, default=d.batch_size,
+                        help="per-worker training batch size")
+    parser.add_argument("--test-batch-size", type=int, default=d.test_batch_size)
+    parser.add_argument("--epochs", type=int, default=d.epochs)
+    parser.add_argument("--max-steps", type=int, default=d.max_steps)
+    parser.add_argument("--lr", type=float, default=d.lr)
+    parser.add_argument("--momentum", type=float, default=d.momentum)
+    parser.add_argument("--weight-decay", type=float, default=d.weight_decay)
+    parser.add_argument("--optimizer", type=str, default=d.optimizer,
+                        choices=("sgd", "adam", "amsgrad"))
+    parser.add_argument("--seed", type=int, default=d.seed)
+    parser.add_argument("--log-interval", type=int, default=d.log_interval)
+    parser.add_argument("--network", type=str, default=d.network)
+    parser.add_argument("--dataset", type=str, default=d.dataset)
+    parser.add_argument("--eval-freq", type=int, default=d.eval_freq)
+    parser.add_argument("--train-dir", type=str, default=d.train_dir)
+    parser.add_argument("--data-root", type=str, default=None)
+    parser.add_argument("--no-synthetic", action="store_true",
+                        help="fail instead of falling back to synthetic data")
+    parser.add_argument("--resume", action="store_true",
+                        help="resume from the newest checkpoint in --train-dir")
+    parser.add_argument("--no-checkpoints", action="store_true")
+    parser.add_argument("--shard-mode", type=str, default=d.shard_mode,
+                        choices=("reshuffle", "disjoint"))
+    # accepted-for-parity flags (see module docstring)
+    parser.add_argument("--mode", type=str, default="normal")
+    parser.add_argument("--kill-threshold", type=float, default=7.0)
+    parser.add_argument("--comm-type", type=str, default="Bcast")
+    parser.add_argument("--enable-gpu", type=str, default="")
+    return parser
+
+
+def add_ps_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
+    parser.add_argument("--num-workers", type=int, default=0,
+                        help="mesh size (0 = all visible devices)")
+    parser.add_argument("--num-aggregate", type=int, default=0,
+                        help="aggregate only K of N worker gradients per step "
+                             "(0 = all; reference --num-aggregate)")
+    parser.add_argument("--mask-mode", type=str, default="random_k",
+                        choices=("random_k", "first_k"))
+    parser.add_argument("--compress-grad", type=str, default="none",
+                        choices=("compress", "none"),
+                        help="compress -> int8-quantized gradient collectives")
+    parser.add_argument("--quant-block-size", type=int, default=0,
+                        help="per-block quantization scale granularity (0 = per-tensor)")
+    parser.add_argument("--opt-placement", type=str, default="replicated",
+                        choices=("replicated", "sharded"),
+                        help="where optimizer state lives (sharded = ZeRO-1 PS)")
+    parser.add_argument("--bn-mode", type=str, default="pmean",
+                        choices=("local", "pmean", "synced"))
+    parser.add_argument("--coordinator-address", type=str, default=None,
+                        help="host:port for multi-host DCN rendezvous")
+    parser.add_argument("--num-processes", type=int, default=None)
+    parser.add_argument("--process-id", type=int, default=None)
+    return parser
+
+
+def train_config_from(args: argparse.Namespace) -> TrainConfig:
+    return TrainConfig(
+        network=args.network,
+        dataset=args.dataset,
+        batch_size=args.batch_size,
+        test_batch_size=args.test_batch_size,
+        epochs=args.epochs,
+        max_steps=args.max_steps,
+        lr=args.lr,
+        momentum=args.momentum,
+        weight_decay=args.weight_decay,
+        optimizer=args.optimizer,
+        seed=args.seed,
+        log_interval=args.log_interval,
+        eval_freq=args.eval_freq,
+        train_dir=args.train_dir,
+        save_checkpoints=not args.no_checkpoints,
+        resume=args.resume,
+        data_root=args.data_root,
+        allow_synthetic=not args.no_synthetic,
+        shard_mode=args.shard_mode,
+    )
+
+
+def ps_config_from(args: argparse.Namespace, num_workers: int) -> PSConfig:
+    return PSConfig(
+        num_workers=num_workers,
+        num_aggregate=args.num_aggregate or None,
+        mask_mode=args.mask_mode,
+        compress="int8" if args.compress_grad == "compress" else None,
+        quant_block_size=args.quant_block_size,
+        opt_placement=args.opt_placement,
+        bn_mode=args.bn_mode,
+    )

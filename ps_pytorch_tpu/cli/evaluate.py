@@ -1,0 +1,133 @@
+"""Out-of-band polling evaluator (parity: /root/reference/src/
+distributed_evaluator.py + evaluate_pytorch.sh).
+
+A separate process that shares only a filesystem with the trainer: it polls
+--model-dir for new `model_step_{N}` checkpoints (every --poll-interval
+seconds, reference default 10s — distributed_evaluator.py:88), loads each
+into an initialized model, and reports test loss / Prec@1 / Prec@5
+(distributed_evaluator.py:90-106). `--once` evaluates the newest checkpoint
+and exits; `--timeout` stops after that many idle seconds.
+"""
+
+from __future__ import annotations
+
+import argparse
+from typing import Optional
+
+import jax
+
+from .. import checkpoint as ckpt
+from ..data import BatchIterator, make_preprocessor, prepare_data
+from ..models import build_model, input_shape_for
+from ..optim import build_optimizer
+from ..parallel import PSConfig, init_ps_state, make_mesh, make_ps_eval_step, shard_batch, shard_state
+from ..utils import format_eval_line, get_logger
+
+logger = get_logger()
+
+
+class Evaluator:
+    """Loads step-tagged checkpoints and runs the test split."""
+
+    def __init__(
+        self,
+        network: str,
+        dataset_name: str,
+        model_dir: str,
+        eval_batch_size: int = 1000,
+        data_root: Optional[str] = None,
+        allow_synthetic: bool = True,
+    ):
+        self.model_dir = model_dir
+        self.dataset = prepare_data(
+            dataset_name, root=data_root, allow_synthetic=allow_synthetic
+        )
+        self.pcfg = PSConfig(num_workers=1)
+        self.mesh = make_mesh(num_workers=1)
+        model = build_model(network, num_classes=self.dataset.num_classes)
+        # template state: checkpoints deserialize into this structure
+        tx = build_optimizer("sgd", 0.1)
+        self._template = init_ps_state(
+            model, tx, self.pcfg, jax.random.key(0), input_shape_for(network)
+        )
+        self._eval_step = make_ps_eval_step(
+            model,
+            self.pcfg,
+            self.mesh,
+            preprocess=make_preprocessor(dataset_name, train=False),
+        )
+        self.eval_batch_size = eval_batch_size
+
+    def evaluate_step(self, step: int) -> dict:
+        state = ckpt.load_checkpoint(
+            jax.device_get(self._template), self.model_dir, step
+        )
+        state = shard_state(state, self.mesh, self.pcfg)
+        it = BatchIterator(
+            self.dataset.test_images,
+            self.dataset.test_labels,
+            self.eval_batch_size,
+            shuffle=False,
+        )
+        sums, count = {}, 0
+        for batch in it:
+            m = jax.device_get(
+                self._eval_step(state, shard_batch(batch, self.mesh, self.pcfg))
+            )
+            for k, v in m.items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+            count += 1
+        out = {k: v / max(count, 1) for k, v in sums.items()}
+        logger.info(format_eval_line(step, out["loss"], out["prec1"], out["prec5"]))
+        return out
+
+    def run(
+        self,
+        poll_interval: float = 10.0,
+        timeout: Optional[float] = None,
+        once: bool = False,
+    ) -> dict:
+        results = {}
+        if once:
+            step = ckpt.latest_step(self.model_dir)
+            if step is None:
+                logger.info("no checkpoints in %s", self.model_dir)
+                return results
+            results[step] = self.evaluate_step(step)
+            return results
+        for step in ckpt.poll_checkpoints(
+            self.model_dir, interval_s=poll_interval, timeout_s=timeout
+        ):
+            results[step] = self.evaluate_step(step)
+        return results
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser("ps_pytorch_tpu.cli.evaluate")
+    parser.add_argument("--eval-batch-size", type=int, default=1000)
+    parser.add_argument("--model-dir", type=str, default="output/models/")
+    parser.add_argument("--dataset", type=str, default="MNIST")
+    parser.add_argument("--network", type=str, default="LeNet")
+    parser.add_argument("--data-root", type=str, default=None)
+    parser.add_argument("--no-synthetic", action="store_true")
+    parser.add_argument("--poll-interval", type=float, default=10.0)
+    parser.add_argument("--timeout", type=float, default=None,
+                        help="stop after this many idle seconds (default: poll forever)")
+    parser.add_argument("--once", action="store_true",
+                        help="evaluate the newest checkpoint and exit")
+    args = parser.parse_args(argv)
+    ev = Evaluator(
+        args.network,
+        args.dataset,
+        args.model_dir,
+        eval_batch_size=args.eval_batch_size,
+        data_root=args.data_root,
+        allow_synthetic=not args.no_synthetic,
+    )
+    return ev.run(
+        poll_interval=args.poll_interval, timeout=args.timeout, once=args.once
+    )
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,228 @@
+"""Training drivers: the host-side loop around the jitted PS train step.
+
+This is the TPU-native collapse of the reference's three role runtimes
+(SURVEY.md sections 1-3). `SyncReplicasMaster_NN.start()` (sync_replicas_
+master_nn.py:133-197), `DistributedWorker.train()` (distributed_worker.py:
+104-180) and the single-machine `NN_Trainer.train_and_validate` (nn_ops.py:
+48-88) all become ONE driver: under SPMD there is no master process, no
+worker processes, no step handshake — a single host loop dispatches one
+fused XLA program per global step over the whole mesh. `num_workers=1` on
+one chip is exactly the reference's single_machine.py baseline.
+
+The driver owns everything the reference's role runtimes owned that is not
+the step itself: epoch iteration, per-iteration reference-format log lines
+(utils/logging.py), eval cadence, single-writer checkpoints, and resume
+(which the reference lacks — sync_replicas_master_nn.py:102 always restarts
+at step 1).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+import jax
+import numpy as np
+
+from . import checkpoint as ckpt
+from .data import (
+    BatchIterator,
+    Dataset,
+    make_preprocessor,
+    prepare_data,
+    shard_for_worker,
+)
+from .models import build_model, init_model, input_shape_for, param_count
+from .optim import build_optimizer
+from .parallel import (
+    PSConfig,
+    init_ps_state,
+    make_mesh,
+    make_ps_eval_step,
+    make_ps_train_step,
+    shard_batch,
+    shard_state,
+)
+from .utils import PhaseTimer, format_eval_line, format_iter_line, get_logger
+
+logger = get_logger()
+
+
+@dataclasses.dataclass
+class TrainConfig:
+    """Host-loop configuration, mirroring the reference CLI surface
+    (/root/reference/src/distributed_nn.py:24-68). Engine-level knobs
+    (num_aggregate, compression, placement, BN mode) live in PSConfig."""
+
+    network: str = "LeNet"
+    dataset: str = "MNIST"
+    batch_size: int = 128  # per-worker batch, reference --batch-size
+    test_batch_size: int = 500
+    epochs: int = 100
+    max_steps: int = 10000
+    lr: float = 0.01
+    momentum: float = 0.5
+    weight_decay: float = 0.0
+    optimizer: str = "sgd"  # sgd | adam (reference optim/)
+    seed: int = 1
+    log_interval: int = 10
+    eval_freq: int = 50
+    train_dir: str = "output/models/"
+    save_checkpoints: bool = True
+    resume: bool = False
+    data_root: Optional[str] = None
+    allow_synthetic: bool = True
+    shard_mode: str = "reshuffle"  # reference parity; "disjoint" improvement
+
+
+class Trainer:
+    """Drives PS data-parallel training of one model on one mesh."""
+
+    def __init__(self, tcfg: TrainConfig, pcfg: PSConfig, dataset: Optional[Dataset] = None):
+        self.tcfg, self.pcfg = tcfg, pcfg
+        self.dataset = dataset or prepare_data(
+            tcfg.dataset, root=tcfg.data_root, allow_synthetic=tcfg.allow_synthetic
+        )
+        self.mesh = make_mesh(num_workers=pcfg.num_workers)
+        self.model = build_model(
+            tcfg.network,
+            num_classes=self.dataset.num_classes,
+            bn_axis_name=pcfg.axis_name if pcfg.bn_mode == "synced" else None,
+        )
+        self.tx = build_optimizer(
+            tcfg.optimizer,
+            tcfg.lr,
+            momentum=tcfg.momentum,
+            weight_decay=tcfg.weight_decay,
+        )
+        shape = input_shape_for(tcfg.network)
+        state = init_ps_state(
+            self.model, self.tx, pcfg, jax.random.key(tcfg.seed), shape
+        )
+        self.state = shard_state(state, self.mesh, pcfg)
+        pre_train = make_preprocessor(tcfg.dataset, train=True)
+        pre_eval = make_preprocessor(tcfg.dataset, train=False)
+        self._train_step = make_ps_train_step(
+            self.model, self.tx, pcfg, self.mesh, preprocess=pre_train
+        )
+        self._eval_step = make_ps_eval_step(
+            self.model, pcfg, self.mesh, preprocess=pre_eval
+        )
+        self._key = jax.random.key(tcfg.seed + 1)
+        logger.info(
+            "model %s (%d params), dataset %s%s, %d workers",
+            tcfg.network,
+            param_count(state.params),
+            self.dataset.name,
+            " [synthetic]" if self.dataset.synthetic else "",
+            pcfg.num_workers,
+        )
+
+    # ------------------------------------------------------------------ resume
+    def try_resume(self) -> Optional[int]:
+        """Restore the newest checkpoint from train_dir, if any."""
+        step = ckpt.latest_step(self.tcfg.train_dir)
+        if step is None:
+            return None
+        target = jax.device_get(self.state)
+        restored = ckpt.load_checkpoint(target, self.tcfg.train_dir, step)
+        self.state = shard_state(restored, self.mesh, self.pcfg)
+        logger.info("resumed from %s", ckpt.checkpoint_path(self.tcfg.train_dir, step))
+        return step
+
+    # ------------------------------------------------------------------- train
+    def train(self) -> dict:
+        """Run up to epochs/max_steps. Returns final metrics."""
+        t = self.tcfg
+        if t.resume:
+            self.try_resume()
+        global_batch = t.batch_size * self.pcfg.num_workers
+        # reference parity: each worker shuffles the full set independently
+        # (loader.py docstring); the global batch stacks per-worker slices.
+        iters = []
+        for w in range(self.pcfg.num_workers):
+            imgs, labels, seed = shard_for_worker(
+                self.dataset.train_images,
+                self.dataset.train_labels,
+                w,
+                self.pcfg.num_workers,
+                mode=t.shard_mode,
+                seed=t.seed,
+            )
+            iters.append(BatchIterator(imgs, labels, t.batch_size, seed=seed))
+        total = iters[0].num_samples
+        steps_per_epoch = len(iters[0])
+        metrics = {}
+        step_no = int(jax.device_get(self.state.step))
+        timer = PhaseTimer()
+        done = False
+        for epoch in range(1, t.epochs + 1):
+            if done:
+                break
+            epochs_iters = [it.epoch() for it in iters]
+            for batch_idx in range(steps_per_epoch):
+                timer.reset()
+                with timer.phase("fetch"):
+                    parts = [next(ei) for ei in epochs_iters]
+                    batch = {
+                        k: np.concatenate([p[k] for p in parts]) for k in parts[0]
+                    }
+                    sharded = shard_batch(batch, self.mesh, self.pcfg)
+                with timer.phase("step"):
+                    self.state, metrics = self._train_step(
+                        self.state, sharded, self._key
+                    )
+                    metrics = jax.device_get(metrics)
+                step_no += 1
+                if step_no % t.log_interval == 0 or step_no == 1:
+                    logger.info(
+                        format_iter_line(
+                            rank="mesh",
+                            step=step_no,
+                            epoch=epoch,
+                            seen=batch_idx * global_batch,
+                            total=total * self.pcfg.num_workers,
+                            loss=float(metrics["loss"]),
+                            time_cost=timer.total,
+                            fetch=timer.durations.get("fetch", 0.0),
+                            forward=timer.durations.get("step", 0.0),
+                        )
+                    )
+                if t.save_checkpoints and step_no % t.eval_freq == 0:
+                    ckpt.save_checkpoint(
+                        jax.device_get(self.state), t.train_dir, step_no
+                    )
+                if step_no >= t.max_steps:
+                    done = True
+                    break
+        if t.save_checkpoints and metrics:
+            ckpt.save_checkpoint(jax.device_get(self.state), t.train_dir, step_no)
+        return {k: float(v) for k, v in metrics.items()}
+
+    # ---------------------------------------------------------------- validate
+    def validate(self) -> dict:
+        """Full pass over the test split (parity: nn_ops.py:90-106)."""
+        t = self.tcfg
+        n = self.pcfg.num_workers
+        bs = max(t.test_batch_size // n, 1) * n
+        it = BatchIterator(
+            self.dataset.test_images,
+            self.dataset.test_labels,
+            bs,
+            shuffle=False,
+        )
+        sums, count = {}, 0
+        for batch in it:
+            m = jax.device_get(
+                self._eval_step(self.state, shard_batch(batch, self.mesh, self.pcfg))
+            )
+            for k, v in m.items():
+                sums[k] = sums.get(k, 0.0) + float(v)
+            count += 1
+        out = {k: v / max(count, 1) for k, v in sums.items()}
+        if out:
+            step_no = int(jax.device_get(self.state.step))
+            logger.info(
+                format_eval_line(step_no, out["loss"], out["prec1"], out["prec5"])
+            )
+        return out

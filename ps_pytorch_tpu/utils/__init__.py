@@ -1,0 +1,11 @@
+"""Utilities: structured iteration logging (reference-parseable), phase
+timing, and profiler hooks."""
+
+from .logging import (
+    ITER_LOG_RE,
+    PhaseTimer,
+    format_eval_line,
+    format_iter_line,
+    get_logger,
+    parse_iter_line,
+)
