@@ -1,0 +1,371 @@
+// psnative codec — host-side byte codec for checkpoints and DCN payloads.
+//
+// This is the TPU build's native equivalent of the reference's c-blosc
+// dependency (reference: src/compression.py uses python-blosc pack_array/
+// unpack_array with the snappy codec; installed by tools/pre_run.sh). On the
+// ICI gradient path compression is an int8 Pallas kernel (ops/quantize.py);
+// this C++ codec covers the host paths where a byte codec is the right tool:
+// checkpoint files consumed by the polling evaluator, and cross-DCN blobs.
+//
+// Design (blosc-inspired, own implementation):
+//   stream  := header | block*
+//   header  := magic 'PSC1' (4) | version u8 | itemsize u8 | flags u8 |
+//              reserved u8 | raw_size u64le
+//   block   := raw_len u32le | comp_len u32le | fnv1a u32le | payload
+//              (comp_len == raw_len -> payload stored uncompressed; the
+//               checksum covers the raw (post-shuffle) block bytes, so a
+//               corrupted-but-decodable LZ payload is still rejected)
+// Per block: optional byte shuffle (transpose itemsize x nelem, trailing
+// bytes raw) followed by a greedy LZ with 64 KiB window in an LZ4-like
+// token format: [token: litlen<<4 | matchlen-4] [literal-extension 255*]
+// [literals] [offset u16le] [match-extension 255*]; a block ends with a
+// literals-only tail (match nibble unused). Blocks are independent, so
+// decompression can be parallelized and a torn stream is detected early.
+//
+// All decode paths bounds-check against both source and destination; the
+// decoder never trusts lengths from the wire.
+
+#include <atomic>
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+namespace {
+
+constexpr uint32_t kMagic = 0x31435350;  // "PSC1" little-endian
+constexpr size_t kBlockSize = 1 << 20;
+constexpr size_t kHeaderSize = 16;
+constexpr size_t kBlockHeaderSize = 12;
+constexpr int kMinMatch = 4;
+constexpr int kHashBits = 13;
+
+inline uint32_t read32(const uint8_t* p) {
+  uint32_t v;
+  std::memcpy(&v, p, 4);
+  return v;
+}
+
+inline uint64_t read64(const uint8_t* p) {
+  uint64_t v;
+  std::memcpy(&v, p, 8);
+  return v;
+}
+
+inline void write32(uint8_t* p, uint32_t v) { std::memcpy(p, &v, 4); }
+inline void write64(uint8_t* p, uint64_t v) { std::memcpy(p, &v, 8); }
+
+inline uint32_t hash4(uint32_t v) {
+  return (v * 2654435761u) >> (32 - kHashBits);
+}
+
+uint32_t fnv1a(const uint8_t* p, size_t n) {
+  uint32_t h = 2166136261u;
+  for (size_t i = 0; i < n; ++i) h = (h ^ p[i]) * 16777619u;
+  return h;
+}
+
+// Byte shuffle: [e0b0 e0b1 .. e1b0 e1b1 ..] -> all byte-0s, all byte-1s, ...
+// (trailing n % itemsize bytes are appended unshuffled).
+void shuffle_bytes(const uint8_t* src, uint8_t* dst, size_t n, int itemsize) {
+  size_t nelem = n / itemsize;
+  for (int b = 0; b < itemsize; ++b) {
+    const uint8_t* s = src + b;
+    uint8_t* d = dst + b * nelem;
+    for (size_t i = 0; i < nelem; ++i) d[i] = s[i * itemsize];
+  }
+  std::memcpy(dst + nelem * itemsize, src + nelem * itemsize,
+              n - nelem * itemsize);
+}
+
+void unshuffle_bytes(const uint8_t* src, uint8_t* dst, size_t n,
+                     int itemsize) {
+  size_t nelem = n / itemsize;
+  for (int b = 0; b < itemsize; ++b) {
+    const uint8_t* s = src + b * nelem;
+    uint8_t* d = dst + b;
+    for (size_t i = 0; i < nelem; ++i) d[i * itemsize] = s[i];
+  }
+  std::memcpy(dst + nelem * itemsize, src + nelem * itemsize,
+              n - nelem * itemsize);
+}
+
+// Greedy LZ over one block. Returns compressed size, or 0 if it would not
+// fit in cap (caller then stores the block raw).
+size_t lz_compress(const uint8_t* src, size_t n, uint8_t* dst, size_t cap) {
+  if (n < kMinMatch + 1) return 0;
+  std::vector<int64_t> table(size_t(1) << kHashBits, -1);
+  size_t ip = 0, op = 0, anchor = 0;
+  const size_t match_limit = n - kMinMatch;
+
+  auto emit = [&](size_t lit_len, size_t match_len, size_t offset) -> bool {
+    // worst-case token + extensions + literals + offset
+    size_t need = 1 + lit_len / 255 + 1 + lit_len + 2 + match_len / 255 + 1;
+    if (op + need > cap) return false;
+    uint8_t lit_nib = lit_len >= 15 ? 15 : uint8_t(lit_len);
+    size_t m = match_len >= kMinMatch ? match_len - kMinMatch : 0;
+    uint8_t match_nib = m >= 15 ? 15 : uint8_t(m);
+    dst[op++] = uint8_t(lit_nib << 4 | match_nib);
+    if (lit_nib == 15) {
+      size_t rest = lit_len - 15;
+      while (rest >= 255) { dst[op++] = 255; rest -= 255; }
+      dst[op++] = uint8_t(rest);
+    }
+    std::memcpy(dst + op, src + anchor, lit_len);
+    op += lit_len;
+    if (match_len >= kMinMatch) {
+      dst[op++] = uint8_t(offset & 0xff);
+      dst[op++] = uint8_t(offset >> 8);
+      if (match_nib == 15) {
+        size_t rest = m - 15;
+        while (rest >= 255) { dst[op++] = 255; rest -= 255; }
+        dst[op++] = uint8_t(rest);
+      }
+    }
+    return true;
+  };
+
+  while (ip < match_limit) {
+    uint32_t seq = read32(src + ip);
+    uint32_t h = hash4(seq);
+    int64_t cand = table[h];
+    table[h] = int64_t(ip);
+    if (cand >= 0 && ip - size_t(cand) <= 0xffff &&
+        read32(src + size_t(cand)) == seq) {
+      size_t match_len = kMinMatch;
+      while (ip + match_len < n &&
+             src[size_t(cand) + match_len] == src[ip + match_len])
+        ++match_len;
+      if (!emit(ip - anchor, match_len, ip - size_t(cand))) return 0;
+      ip += match_len;
+      anchor = ip;
+    } else {
+      ++ip;
+    }
+  }
+  if (!emit(n - anchor, 0, 0)) return 0;
+  return op;
+}
+
+// Decode one block; every read/write is bounds-checked. Returns decoded
+// size, or 0 on malformed input.
+size_t lz_decompress(const uint8_t* src, size_t n, uint8_t* dst, size_t cap) {
+  size_t ip = 0, op = 0;
+  while (ip < n) {
+    uint8_t token = src[ip++];
+    size_t lit_len = token >> 4;
+    if (lit_len == 15) {
+      uint8_t b;
+      do {
+        if (ip >= n) return 0;
+        b = src[ip++];
+        lit_len += b;
+      } while (b == 255);
+    }
+    if (ip + lit_len > n || op + lit_len > cap) return 0;
+    std::memcpy(dst + op, src + ip, lit_len);
+    ip += lit_len;
+    op += lit_len;
+    if (ip >= n) break;  // literals-only tail
+    if (ip + 2 > n) return 0;
+    size_t offset = size_t(src[ip]) | size_t(src[ip + 1]) << 8;
+    ip += 2;
+    size_t match_len = (token & 0xf) + kMinMatch;
+    if ((token & 0xf) == 15) {
+      uint8_t b;
+      do {
+        if (ip >= n) return 0;
+        b = src[ip++];
+        match_len += b;
+      } while (b == 255);
+    }
+    if (offset == 0 || offset > op || op + match_len > cap) return 0;
+    // byte-by-byte: overlapping matches (RLE-style) are valid
+    for (size_t i = 0; i < match_len; ++i, ++op) dst[op] = dst[op - offset];
+  }
+  return op;
+}
+
+struct BlockJob {
+  const uint8_t* src;
+  size_t src_len;
+  uint8_t* dst;
+  size_t dst_cap;
+  size_t out_len;  // result
+  uint32_t checksum;  // expected raw checksum (decompress path)
+  int itemsize;
+  bool shuffle;
+  bool ok;
+};
+
+void compress_block(BlockJob* job) {
+  std::vector<uint8_t> shuffled;
+  const uint8_t* data = job->src;
+  if (job->shuffle) {
+    shuffled.resize(job->src_len);
+    shuffle_bytes(job->src, shuffled.data(), job->src_len, job->itemsize);
+    data = shuffled.data();
+  }
+  // only accept compression that actually shrinks the block
+  size_t comp = job->src_len > kBlockHeaderSize
+                    ? lz_compress(data, job->src_len, job->dst + kBlockHeaderSize,
+                                  std::min(job->dst_cap - kBlockHeaderSize,
+                                           job->src_len - 1))
+                    : 0;
+  write32(job->dst, uint32_t(job->src_len));
+  write32(job->dst + 8, fnv1a(data, job->src_len));
+  if (comp == 0) {  // store raw
+    if (job->dst_cap < kBlockHeaderSize + job->src_len) {
+      job->ok = false;
+      return;
+    }
+    write32(job->dst + 4, uint32_t(job->src_len));
+    std::memcpy(job->dst + kBlockHeaderSize, data, job->src_len);
+    job->out_len = kBlockHeaderSize + job->src_len;
+  } else {
+    write32(job->dst + 4, uint32_t(comp));
+    job->out_len = kBlockHeaderSize + comp;
+  }
+  job->ok = true;
+}
+
+void decompress_block(BlockJob* job) {
+  std::vector<uint8_t> tmp;
+  uint8_t* out = job->dst;
+  if (job->shuffle) {
+    tmp.resize(job->dst_cap);
+    out = tmp.data();
+  }
+  size_t got;
+  if (job->src_len == job->dst_cap) {  // stored raw
+    std::memcpy(out, job->src, job->src_len);
+    got = job->src_len;
+  } else {
+    got = lz_decompress(job->src, job->src_len, out, job->dst_cap);
+  }
+  if (got != job->dst_cap || fnv1a(out, got) != job->checksum) {
+    job->ok = false;
+    return;
+  }
+  if (job->shuffle)
+    unshuffle_bytes(tmp.data(), job->dst, job->dst_cap, job->itemsize);
+  job->ok = true;
+}
+
+void run_jobs(std::vector<BlockJob>& jobs, void (*fn)(BlockJob*),
+              int n_threads) {
+  unsigned hw = std::thread::hardware_concurrency();
+  size_t want = n_threads > 0 ? size_t(n_threads) : (hw ? hw : 1);
+  size_t threads = std::min(want, jobs.size());
+  if (threads <= 1) {
+    for (auto& j : jobs) fn(&j);
+    return;
+  }
+  std::vector<std::thread> pool;
+  std::atomic<size_t>* next = new std::atomic<size_t>(0);
+  for (size_t t = 0; t < threads; ++t)
+    pool.emplace_back([&jobs, fn, next]() {
+      for (;;) {
+        size_t i = next->fetch_add(1);
+        if (i >= jobs.size()) return;
+        fn(&jobs[i]);
+      }
+    });
+  for (auto& th : pool) th.join();
+  delete next;
+}
+
+}  // namespace
+
+extern "C" {
+
+// Worst-case output size for n raw bytes.
+size_t psc_max_compressed(size_t n) {
+  size_t blocks = (n + kBlockSize - 1) / kBlockSize;
+  if (blocks == 0) blocks = 1;
+  return kHeaderSize + blocks * kBlockHeaderSize + blocks * kBlockSize;
+}
+
+// Compress n bytes of src into dst (capacity cap). itemsize enables the
+// byte shuffle when > 1 (pass the dtype size); n_threads <= 0 = auto.
+// Returns the stream size, or 0 on failure (cap too small / bad args).
+size_t psc_compress(const uint8_t* src, size_t n, uint8_t* dst, size_t cap,
+                    int itemsize, int n_threads) {
+  if (itemsize < 1 || itemsize > 255 || cap < kHeaderSize) return 0;
+  bool shuffle = itemsize > 1;
+  write32(dst, kMagic);
+  dst[4] = 1;
+  dst[5] = uint8_t(itemsize);
+  dst[6] = shuffle ? 1 : 0;
+  dst[7] = 0;
+  write64(dst + 8, uint64_t(n));
+
+  std::vector<BlockJob> jobs;
+  size_t off = 0;
+  while (off < n) {
+    size_t len = std::min(kBlockSize, n - off);
+    jobs.push_back(
+        BlockJob{src + off, len, nullptr, 0, 0, 0, itemsize, shuffle, false});
+    off += len;
+  }
+  // lay out destination regions pessimistically, then compact
+  size_t dst_off = kHeaderSize;
+  for (auto& j : jobs) {
+    size_t need = kBlockHeaderSize + j.src_len;
+    if (dst_off + need > cap) return 0;
+    j.dst = dst + dst_off;
+    j.dst_cap = need;
+    dst_off += need;
+  }
+  run_jobs(jobs, compress_block, n_threads);
+  size_t out = kHeaderSize;
+  for (auto& j : jobs) {
+    if (!j.ok) return 0;
+    if (dst + out != j.dst) std::memmove(dst + out, j.dst, j.out_len);
+    out += j.out_len;
+  }
+  return out;
+}
+
+// Raw size recorded in a stream header (0 if not a psc stream).
+size_t psc_raw_size(const uint8_t* src, size_t n) {
+  if (n < kHeaderSize || read32(src) != kMagic || src[4] != 1) return 0;
+  return size_t(read64(src + 8));
+}
+
+// Decompress a full stream into dst (capacity cap >= psc_raw_size).
+// Returns decoded size, or 0 on malformed input (note an empty stream also
+// returns 0 — callers distinguish via psc_raw_size). n_threads <= 0 = auto.
+size_t psc_decompress(const uint8_t* src, size_t n, uint8_t* dst, size_t cap,
+                      int n_threads) {
+  if (n < kHeaderSize || read32(src) != kMagic || src[4] != 1) return 0;
+  size_t raw = size_t(read64(src + 8));
+  if (cap < raw) return 0;
+  int itemsize = src[5];
+  bool shuffle = src[6] & 1;
+  if (itemsize < 1) return 0;
+
+  std::vector<BlockJob> jobs;
+  size_t ip = kHeaderSize, op = 0;
+  while (ip < n) {
+    if (ip + kBlockHeaderSize > n) return 0;
+    size_t raw_len = read32(src + ip);
+    size_t comp_len = read32(src + ip + 4);
+    uint32_t checksum = read32(src + ip + 8);
+    ip += kBlockHeaderSize;
+    if (ip + comp_len > n || op + raw_len > raw || comp_len > raw_len ||
+        raw_len > kBlockSize)
+      return 0;
+    jobs.push_back(BlockJob{src + ip, comp_len, dst + op, raw_len, 0,
+                            checksum, itemsize, shuffle, false});
+    ip += comp_len;
+    op += raw_len;
+  }
+  if (op != raw) return 0;
+  run_jobs(jobs, decompress_block, n_threads);
+  for (auto& j : jobs)
+    if (!j.ok) return 0;
+  return raw;
+}
+
+}  // extern "C"

@@ -15,7 +15,10 @@ distributed_worker.py:301-307) consumed by a polling evaluator
   resume a first-class operation (see trainer.PSTrainer.resume).
 
 Format: flax.serialization msgpack bytes of the full PSTrainState (params,
-optimizer state, BN stats, step) — accelerator-agnostic host arrays.
+optimizer state, BN stats, step) — accelerator-agnostic host arrays —
+optionally wrapped in the native C++ codec (ops/codec.py, the Blosc-role
+equivalent: reference compression.py w_compress wraps checkpointed weights
+too). Compressed files carry a 'PSCK' magic; load auto-detects either form.
 """
 
 from __future__ import annotations
@@ -29,6 +32,7 @@ import jax
 from flax import serialization
 
 CKPT_RE = re.compile(r"^model_step_(\d+)$")
+COMPRESSED_MAGIC = b"PSCK"
 
 
 def checkpoint_path(model_dir: str, step: int) -> str:
@@ -36,22 +40,49 @@ def checkpoint_path(model_dir: str, step: int) -> str:
     return os.path.join(model_dir, f"model_step_{step}")
 
 
-def save_checkpoint(state, model_dir: str, step: int) -> str:
+def save_checkpoint(state, model_dir: str, step: int, compress: bool = False) -> str:
     """Atomically write `state` (any flax-serializable pytree) for `step`."""
     os.makedirs(model_dir, exist_ok=True)
     state = jax.device_get(state)
     path = checkpoint_path(model_dir, step)
+    data = serialization.to_bytes(state)
+    if compress:
+        from .ops import codec
+
+        # itemsize 4: the payload is dominated by f32 leaves, so a 4-byte
+        # shuffle feeds the LZ stage well; correctness is itemsize-agnostic
+        data = COMPRESSED_MAGIC + codec.compress_bytes(data, itemsize=4)
     tmp = path + ".tmp"
     with open(tmp, "wb") as f:
-        f.write(serialization.to_bytes(state))
+        f.write(data)
     os.replace(tmp, path)
     return path
 
 
-def load_checkpoint(target, model_dir: str, step: int):
-    """Load step N into the structure of `target` (an initialized state)."""
+def _read_bytes(model_dir: str, step: int) -> bytes:
     with open(checkpoint_path(model_dir, step), "rb") as f:
-        return serialization.from_bytes(target, f.read())
+        data = f.read()
+    if data[:4] == COMPRESSED_MAGIC:
+        from .ops import codec
+
+        data = codec.decompress_bytes(data[4:])
+    return data
+
+
+def load_checkpoint(target, model_dir: str, step: int):
+    """Load step N into the structure of `target` (an initialized state).
+    Auto-detects codec-compressed checkpoints."""
+    return serialization.from_bytes(target, _read_bytes(model_dir, step))
+
+
+def load_checkpoint_raw(model_dir: str, step: int) -> dict:
+    """Load step N as raw nested dicts, no target structure required.
+
+    This is what lets the evaluator stay ignorant of the trainer's optimizer
+    and placement config: it only consumes params/batch_stats/step and never
+    needs to reconstruct the opt_state pytree (whose structure varies by
+    --optimizer/--opt-placement)."""
+    return serialization.msgpack_restore(_read_bytes(model_dir, step))
 
 
 def available_steps(model_dir: str):

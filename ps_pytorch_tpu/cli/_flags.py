@@ -48,6 +48,8 @@ def add_train_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--resume", action="store_true",
                         help="resume from the newest checkpoint in --train-dir")
     parser.add_argument("--no-checkpoints", action="store_true")
+    parser.add_argument("--compress-checkpoints", action="store_true",
+                        help="write checkpoints through the native C++ codec")
     parser.add_argument("--shard-mode", type=str, default=d.shard_mode,
                         choices=("reshuffle", "disjoint"))
     # accepted-for-parity flags (see module docstring)
@@ -100,6 +102,7 @@ def train_config_from(args: argparse.Namespace) -> TrainConfig:
         eval_freq=args.eval_freq,
         train_dir=args.train_dir,
         save_checkpoints=not args.no_checkpoints,
+        compress_checkpoints=args.compress_checkpoints,
         resume=args.resume,
         data_root=args.data_root,
         allow_synthetic=not args.no_synthetic,

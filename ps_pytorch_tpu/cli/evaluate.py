@@ -3,10 +3,15 @@ distributed_evaluator.py + evaluate_pytorch.sh).
 
 A separate process that shares only a filesystem with the trainer: it polls
 --model-dir for new `model_step_{N}` checkpoints (every --poll-interval
-seconds, reference default 10s — distributed_evaluator.py:88), loads each
-into an initialized model, and reports test loss / Prec@1 / Prec@5
-(distributed_evaluator.py:90-106). `--once` evaluates the newest checkpoint
-and exits; `--timeout` stops after that many idle seconds.
+seconds, reference default 10s — distributed_evaluator.py:88), loads each,
+and reports test loss / Prec@1 / Prec@5 (distributed_evaluator.py:90-106).
+`--once` evaluates the newest checkpoint and exits; `--timeout` stops after
+that many idle seconds.
+
+Checkpoints are loaded structure-free (checkpoint.load_checkpoint_raw), so
+the evaluator needs only --network/--dataset — never the trainer's
+optimizer, placement, or BN-mode configuration. Per-worker ("local") BN
+stats saved with a stacked leading worker axis are averaged for evaluation.
 """
 
 from __future__ import annotations
@@ -15,19 +20,20 @@ import argparse
 from typing import Optional
 
 import jax
+import jax.numpy as jnp
 
 from .. import checkpoint as ckpt
 from ..data import BatchIterator, make_preprocessor, prepare_data
-from ..models import build_model, input_shape_for
-from ..optim import build_optimizer
-from ..parallel import PSConfig, init_ps_state, make_mesh, make_ps_eval_step, shard_batch, shard_state
+from ..models import apply_model, build_model, init_model, input_shape_for
+from ..ops.metrics import accuracy, cross_entropy_loss
+from ..trainer import average_metrics
 from ..utils import format_eval_line, get_logger
 
 logger = get_logger()
 
 
 class Evaluator:
-    """Loads step-tagged checkpoints and runs the test split."""
+    """Loads step-tagged checkpoints and runs the test split on one device."""
 
     def __init__(
         self,
@@ -42,42 +48,52 @@ class Evaluator:
         self.dataset = prepare_data(
             dataset_name, root=data_root, allow_synthetic=allow_synthetic
         )
-        self.pcfg = PSConfig(num_workers=1)
-        self.mesh = make_mesh(num_workers=1)
-        model = build_model(network, num_classes=self.dataset.num_classes)
-        # template state: checkpoints deserialize into this structure
-        tx = build_optimizer("sgd", 0.1)
-        self._template = init_ps_state(
-            model, tx, self.pcfg, jax.random.key(0), input_shape_for(network)
+        self.model = build_model(network, num_classes=self.dataset.num_classes)
+        # only used to recognize the expected batch_stats leaf ranks
+        _, self._bn_template = init_model(
+            self.model, jax.random.key(0), input_shape_for(network)
         )
-        self._eval_step = make_ps_eval_step(
-            model,
-            self.pcfg,
-            self.mesh,
-            preprocess=make_preprocessor(dataset_name, train=False),
-        )
+        pre = make_preprocessor(dataset_name, train=False)
+
+        def eval_fn(params, batch_stats, images, labels):
+            x = pre(None, images)
+            logits, _ = apply_model(self.model, params, batch_stats, x, train=False)
+            loss = cross_entropy_loss(logits, labels)
+            prec1, prec5 = accuracy(logits, labels, (1, 5))
+            return {"loss": loss, "prec1": prec1, "prec5": prec5}
+
+        self._eval_fn = jax.jit(eval_fn)
         self.eval_batch_size = eval_batch_size
 
+    def _extract(self, raw: dict):
+        """Pull params/batch_stats out of a raw checkpoint dict; average
+        stacked per-worker BN stats (bn_mode='local' trainer runs)."""
+        params = raw["params"]
+        batch_stats = raw.get("batch_stats") or {}
+        expected = jax.tree_util.tree_leaves(self._bn_template)
+        got = jax.tree_util.tree_leaves(batch_stats)
+        if expected and got and got[0].ndim == expected[0].ndim + 1:
+            batch_stats = jax.tree_util.tree_map(
+                lambda x: jnp.mean(x, axis=0), batch_stats
+            )
+        return params, batch_stats
+
     def evaluate_step(self, step: int) -> dict:
-        state = ckpt.load_checkpoint(
-            jax.device_get(self._template), self.model_dir, step
+        params, batch_stats = self._extract(
+            ckpt.load_checkpoint_raw(self.model_dir, step)
         )
-        state = shard_state(state, self.mesh, self.pcfg)
         it = BatchIterator(
             self.dataset.test_images,
             self.dataset.test_labels,
             self.eval_batch_size,
             shuffle=False,
         )
-        sums, count = {}, 0
-        for batch in it:
-            m = jax.device_get(
-                self._eval_step(state, shard_batch(batch, self.mesh, self.pcfg))
-            )
-            for k, v in m.items():
-                sums[k] = sums.get(k, 0.0) + float(v)
-            count += 1
-        out = {k: v / max(count, 1) for k, v in sums.items()}
+        out = average_metrics(
+            lambda b: self._eval_fn(
+                params, batch_stats, jnp.asarray(b["image"]), jnp.asarray(b["label"])
+            ),
+            it,
+        )
         logger.info(format_eval_line(step, out["loss"], out["prec1"], out["prec5"]))
         return out
 

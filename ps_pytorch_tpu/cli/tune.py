@@ -1,0 +1,89 @@
+"""LR sweep harness (parity: /root/reference/src/tune.sh — a grid of
+learning rates each launched as a full mpirun job — plus
+tiny_tuning_parser.py:14-27, which regex-parses the worker logs and averages
+the reported loss).
+
+Here the sweep runs in-process (one mesh, sequential short runs) and the
+scoring path is deliberately the same as the reference's: each run's
+iteration log lines are captured and fed through utils.parse_iter_line, and
+the candidate's score is the mean loss over its final --score-window steps.
+Prints a ranking and returns {lr: score}.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+import jax
+
+from ..data import prepare_data
+from ..trainer import Trainer
+from ..utils import get_logger, parse_iter_line
+from ._flags import add_ps_flags, add_train_flags, ps_config_from, train_config_from
+
+logger = get_logger()
+
+DEFAULT_GRID = (0.1, 0.05, 0.02, 0.01, 0.005, 0.002, 0.001)  # tune.sh's 7 LRs
+
+
+class _LineCapture(logging.Handler):
+    def __init__(self):
+        super().__init__()
+        self.lines = []
+
+    def emit(self, record):
+        self.lines.append(record.getMessage())
+
+
+def score_lines(lines, window: int) -> float:
+    """Mean loss over the last `window` parsed iteration lines
+    (tiny_tuning_parser semantics: scrape logs, average loss). A run that
+    ever reported a non-finite loss is scored inf — a diverged lr must not
+    win on its pre-divergence prefix."""
+    import math
+
+    losses = [d["loss"] for d in map(parse_iter_line, lines) if d]
+    if not losses or any(not math.isfinite(x) for x in losses):
+        return float("inf")
+    return sum(losses[-window:]) / len(losses[-window:])
+
+
+def main(argv=None) -> dict:
+    parser = argparse.ArgumentParser("ps_pytorch_tpu.cli.tune")
+    add_train_flags(parser)
+    add_ps_flags(parser)
+    parser.add_argument("--lr-grid", type=float, nargs="+",
+                        default=list(DEFAULT_GRID))
+    parser.add_argument("--score-window", type=int, default=10,
+                        help="average the loss over the final N logged steps")
+    args = parser.parse_args(argv)
+
+    num_workers = args.num_workers or len(jax.devices())
+    base = train_config_from(args)
+    dataset = prepare_data(
+        base.dataset, root=base.data_root, allow_synthetic=base.allow_synthetic
+    )  # load once; each grid point reuses it
+    results = {}
+    for lr in args.lr_grid:
+        tcfg = train_config_from(args)
+        tcfg.lr = lr
+        tcfg.log_interval = 1  # score every step
+        tcfg.save_checkpoints = False
+        pcfg = ps_config_from(args, num_workers)
+        capture = _LineCapture()
+        logger.addHandler(capture)
+        try:
+            Trainer(tcfg, pcfg, dataset=dataset).train()
+        finally:
+            logger.removeHandler(capture)
+        results[lr] = score_lines(capture.lines, args.score_window)
+        logger.info("lr %g -> mean loss %.4f", lr, results[lr])
+
+    ranking = sorted(results.items(), key=lambda kv: kv[1])
+    logger.info("best lr: %g (mean loss %.4f)", *ranking[0])
+    return results
+
+
+if __name__ == "__main__":
+    main()

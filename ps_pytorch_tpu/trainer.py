@@ -32,7 +32,7 @@ from .data import (
     prepare_data,
     shard_for_worker,
 )
-from .models import build_model, init_model, input_shape_for, param_count
+from .models import build_model, input_shape_for, param_count
 from .optim import build_optimizer
 from .parallel import (
     PSConfig,
@@ -46,6 +46,19 @@ from .parallel import (
 from .utils import PhaseTimer, format_eval_line, format_iter_line, get_logger
 
 logger = get_logger()
+
+
+def average_metrics(step_fn, batches) -> dict:
+    """Uniform average of per-batch metric dicts (batches are equal-sized:
+    BatchIterator drops partial tails). Shared by Trainer.validate and the
+    out-of-band Evaluator."""
+    sums, count = {}, 0
+    for batch in batches:
+        m = jax.device_get(step_fn(batch))
+        for k, v in m.items():
+            sums[k] = sums.get(k, 0.0) + float(v)
+        count += 1
+    return {k: v / max(count, 1) for k, v in sums.items()}
 
 
 @dataclasses.dataclass
@@ -69,6 +82,7 @@ class TrainConfig:
     eval_freq: int = 50
     train_dir: str = "output/models/"
     save_checkpoints: bool = True
+    compress_checkpoints: bool = False  # native C++ codec (ops/codec.py)
     resume: bool = False
     data_root: Optional[str] = None
     allow_synthetic: bool = True
@@ -156,11 +170,17 @@ class Trainer:
         step_no = int(jax.device_get(self.state.step))
         timer = PhaseTimer()
         done = False
+        last_saved = None
         for epoch in range(1, t.epochs + 1):
             if done:
                 break
             epochs_iters = [it.epoch() for it in iters]
             for batch_idx in range(steps_per_epoch):
+                if step_no >= t.max_steps:
+                    # check BEFORE stepping so a --resume of a finished run
+                    # is a no-op instead of overshooting max_steps
+                    done = True
+                    break
                 timer.reset()
                 with timer.phase("fetch"):
                     parts = [next(ei) for ei in epochs_iters]
@@ -190,13 +210,22 @@ class Trainer:
                     )
                 if t.save_checkpoints and step_no % t.eval_freq == 0:
                     ckpt.save_checkpoint(
-                        jax.device_get(self.state), t.train_dir, step_no
+                        jax.device_get(self.state),
+                        t.train_dir,
+                        step_no,
+                        compress=t.compress_checkpoints,
                     )
+                    last_saved = step_no
                 if step_no >= t.max_steps:
                     done = True
                     break
-        if t.save_checkpoints and metrics:
-            ckpt.save_checkpoint(jax.device_get(self.state), t.train_dir, step_no)
+        if t.save_checkpoints and metrics and last_saved != step_no:
+            ckpt.save_checkpoint(
+                jax.device_get(self.state),
+                t.train_dir,
+                step_no,
+                compress=t.compress_checkpoints,
+            )
         return {k: float(v) for k, v in metrics.items()}
 
     # ---------------------------------------------------------------- validate
@@ -211,15 +240,10 @@ class Trainer:
             bs,
             shuffle=False,
         )
-        sums, count = {}, 0
-        for batch in it:
-            m = jax.device_get(
-                self._eval_step(self.state, shard_batch(batch, self.mesh, self.pcfg))
-            )
-            for k, v in m.items():
-                sums[k] = sums.get(k, 0.0) + float(v)
-            count += 1
-        out = {k: v / max(count, 1) for k, v in sums.items()}
+        out = average_metrics(
+            lambda b: self._eval_step(self.state, shard_batch(b, self.mesh, self.pcfg)),
+            it,
+        )
         if out:
             step_no = int(jax.device_get(self.state.step))
             logger.info(

@@ -38,7 +38,7 @@ _FMT = (
 ITER_LOG_RE = re.compile(
     r"Worker: (?P<rank>\S+), Step: (?P<step>\d+), Epoch: (?P<epoch>\d+) "
     r"\[(?P<seen>\d+)/(?P<total>\d+) \((?P<pct>[\d.]+)%\)\], "
-    r"Loss: (?P<loss>[\d.eE+-]+), Time Cost: (?P<time_cost>[\d.eE+-]+), "
+    r"Loss: (?P<loss>[\d.eE+-]+|-?nan|-?inf), Time Cost: (?P<time_cost>[\d.eE+-]+), "
     r"FetchWeight: (?P<fetch>[\d.eE+-]+), Forward: (?P<forward>[\d.eE+-]+), "
     r"Backward: (?P<backward>[\d.eE+-]+), Comm Cost: (?P<comm>[\d.eE+-]+)"
 )

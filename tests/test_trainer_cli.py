@@ -144,3 +144,59 @@ def test_iter_log_line_roundtrip():
     )
     d = parse_iter_line(ref_like)
     assert d["comm"] == pytest.approx(0.1415)
+
+
+def test_resume_of_finished_run_is_noop(tmp_path, tiny_ds):
+    tcfg = _tcfg(tmp_path, max_steps=4, eval_freq=2)
+    pcfg = PSConfig(num_workers=2)
+    Trainer(tcfg, pcfg, dataset=tiny_ds).train()
+    steps_before = ckpt.available_steps(tcfg.train_dir)
+
+    tcfg2 = _tcfg(tmp_path, max_steps=4, eval_freq=2, resume=True)
+    tr = Trainer(tcfg2, pcfg, dataset=tiny_ds)
+    tr.train()
+    assert int(jax.device_get(tr.state.step)) == 4  # no overshoot
+    assert ckpt.available_steps(tcfg.train_dir) == steps_before
+
+
+def test_evaluator_handles_adam_checkpoints(tmp_path, tiny_ds):
+    # the evaluator must not depend on the trainer's optimizer structure
+    tcfg = _tcfg(tmp_path, max_steps=2, eval_freq=2, optimizer="adam")
+    Trainer(tcfg, PSConfig(num_workers=2), dataset=tiny_ds).train()
+
+    from ps_pytorch_tpu.cli.evaluate import Evaluator
+
+    ev = Evaluator("LeNet", "MNIST", tcfg.train_dir, eval_batch_size=64)
+    results = ev.run(once=True)
+    assert np.isfinite(results[2]["loss"])
+
+
+def test_evaluator_handles_local_bn_checkpoints(tmp_path):
+    # bn_mode="local" stacks per-worker BN stats; the evaluator averages them
+    ds = make_synthetic("Cifar10", train_size=64, test_size=32, seed=0)
+    tcfg = _tcfg(
+        tmp_path, network="ResNet18", dataset="Cifar10", max_steps=2,
+        eval_freq=2, batch_size=8,
+    )
+    Trainer(tcfg, PSConfig(num_workers=2, bn_mode="local"), dataset=ds).train()
+
+    from ps_pytorch_tpu.cli.evaluate import Evaluator
+
+    ev = Evaluator("ResNet18", "Cifar10", tcfg.train_dir, eval_batch_size=32)
+    results = ev.run(once=True)
+    assert np.isfinite(results[2]["loss"])
+
+
+def test_cli_tune_main(tmp_path, monkeypatch):
+    monkeypatch.setenv("PS_TPU_DATA_DIR", str(tmp_path / "nodata"))
+    from ps_pytorch_tpu.cli.tune import main
+
+    out = main(
+        [
+            "--network", "LeNet", "--num-workers", "2", "--batch-size", "8",
+            "--max-steps", "4", "--lr-grid", "0.01", "0.5",
+            "--score-window", "2", "--train-dir", str(tmp_path / "m"),
+        ]
+    )
+    assert set(out) == {0.01, 0.5}
+    assert all(np.isfinite(v) for v in out.values())
