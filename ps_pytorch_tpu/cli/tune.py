@@ -70,6 +70,7 @@ def main(argv=None) -> dict:
         tcfg.lr = lr
         tcfg.log_interval = 1  # score every step
         tcfg.save_checkpoints = False
+        tcfg.resume = False  # every candidate must start from scratch
         pcfg = ps_config_from(args, num_workers)
         capture = _LineCapture()
         logger.addHandler(capture)

@@ -1,0 +1,140 @@
+"""Sequence-parallel transformer LM — the long-context model family.
+
+The reference has no attention workloads (SURVEY.md section 5), so this
+family has no counterpart to cite; it exists because long-context is a
+first-class capability of this framework. The design splits the sequence
+axis across the mesh (parallel/ring_attention.py): every non-attention op
+(embed, norms, MLP) is pointwise over sequence and runs on local shards
+with zero communication; attention is the ring. Params stay replicated, so
+the PS data-parallel engine and the sequence axis compose on a 2-D mesh
+(dp x sp) without re-sharding weights.
+
+Pure init/apply (no flax.linen) so the module works identically inside and
+outside shard_map.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..parallel.ring_attention import SEQ_AXIS, full_attention, ring_attention
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 256
+    dim: int = 128
+    depth: int = 2
+    heads: int = 4
+    mlp_ratio: int = 4
+    max_seq_len: int = 2048
+    causal: bool = True
+    dtype: Any = jnp.float32
+
+    @property
+    def head_dim(self) -> int:
+        assert self.dim % self.heads == 0
+        return self.dim // self.heads
+
+
+def _dense_init(key, shape, dtype, scale=None):
+    fan_in = shape[0]
+    scale = scale if scale is not None else 1.0 / (fan_in ** 0.5)
+    return (jax.random.normal(key, shape) * scale).astype(dtype)
+
+
+def init_transformer(cfg: TransformerConfig, key: jax.Array) -> Dict:
+    keys = jax.random.split(key, 2 + cfg.depth)
+    params = {
+        "embed": (
+            jax.random.normal(keys[0], (cfg.vocab_size, cfg.dim)) * 0.02
+        ).astype(cfg.dtype),
+        "pos_embed": (
+            jax.random.normal(keys[1], (cfg.max_seq_len, cfg.dim)) * 0.02
+        ).astype(cfg.dtype),
+        "blocks": [],
+        "out_norm": jnp.ones((cfg.dim,), cfg.dtype),
+    }
+    for i in range(cfg.depth):
+        bk = jax.random.split(keys[2 + i], 6)
+        mlp_dim = cfg.dim * cfg.mlp_ratio
+        params["blocks"].append(
+            {
+                "ln1": jnp.ones((cfg.dim,), cfg.dtype),
+                "wqkv": _dense_init(bk[0], (cfg.dim, 3 * cfg.dim), cfg.dtype),
+                "wo": _dense_init(bk[1], (cfg.dim, cfg.dim), cfg.dtype),
+                "ln2": jnp.ones((cfg.dim,), cfg.dtype),
+                "w_up": _dense_init(bk[2], (cfg.dim, mlp_dim), cfg.dtype),
+                "w_down": _dense_init(bk[3], (mlp_dim, cfg.dim), cfg.dtype),
+            }
+        )
+    return params
+
+
+def _rms_norm(x, gamma, eps=1e-6):
+    var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
+    return x * jax.lax.rsqrt(var + eps) * gamma
+
+
+def apply_transformer(
+    cfg: TransformerConfig,
+    params: Dict,
+    tokens: jax.Array,  # int32 [B, T_local]
+    seq_axis_name: Optional[str] = None,
+    pos_offset: Optional[jax.Array] = None,
+) -> jax.Array:
+    """Forward -> logits [B, T_local, vocab].
+
+    Under shard_map pass seq_axis_name: attention runs on the ring and
+    positional embeddings index by GLOBAL position (shard offset). Outside
+    shard_map (seq_axis_name=None) this is the plain single-device model.
+    """
+    b, t_loc = tokens.shape
+    if seq_axis_name is not None:
+        shard = jax.lax.axis_index(seq_axis_name) * t_loc
+        attend = partial(ring_attention, axis_name=seq_axis_name, causal=cfg.causal)
+    else:
+        shard = 0
+        attend = partial(full_attention, causal=cfg.causal)
+    if pos_offset is not None:
+        shard = shard + pos_offset
+    pos = shard + jnp.arange(t_loc)
+    x = params["embed"][tokens] + params["pos_embed"][pos][None]
+
+    for blk in params["blocks"]:
+        h = _rms_norm(x, blk["ln1"])
+        qkv = h @ blk["wqkv"]
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        split_heads = lambda a: a.reshape(b, t_loc, cfg.heads, cfg.head_dim)
+        o = attend(split_heads(q), split_heads(k), split_heads(v))
+        x = x + o.reshape(b, t_loc, cfg.dim) @ blk["wo"]
+        h = _rms_norm(x, blk["ln2"])
+        x = x + jax.nn.gelu(h @ blk["w_up"]) @ blk["w_down"]
+
+    return _rms_norm(x, params["out_norm"]) @ params["embed"].T
+
+
+def make_sp_forward(
+    cfg: TransformerConfig,
+    mesh: Mesh,
+    axis_name: str = SEQ_AXIS,
+    jit: bool = True,
+):
+    """Sequence-parallel forward: params replicated, tokens/logits sharded
+    [B, T] / [B, T, V] along the sequence axis. This is the ONE place the
+    sp sharding contract lives — pass jit=False to compose the mapped fn
+    inside a larger jitted computation (e.g. a loss)."""
+    mapped = jax.shard_map(
+        lambda p, tok: apply_transformer(cfg, p, tok, seq_axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(P(), P(None, axis_name)),
+        out_specs=P(None, axis_name),
+        check_vma=False,
+    )
+    return jax.jit(mapped) if jit else mapped

@@ -102,19 +102,33 @@ def _as_u8p(buf: bytearray):
     )
 
 
+def _as_const_u8p(data: bytes):
+    """Zero-copy read-only view of a bytes object for the C side (which
+    only reads src) — avoids duplicating checkpoint-sized buffers."""
+    return ctypes.cast(ctypes.c_char_p(data or b"\0"), ctypes.POINTER(ctypes.c_uint8))
+
+
 def compress_bytes(data: bytes, itemsize: int = 1, n_threads: int = 0) -> bytes:
     """Compress raw bytes (native codec, zlib fallback prefixed 'Z')."""
     lib = _load()
     if lib is None:
         return b"Z" + zlib.compress(data, 6)
     n = len(data)
-    src = bytearray(data) if n else bytearray(1)
     cap = lib.psc_max_compressed(n)
-    dst = bytearray(cap)
-    got = lib.psc_compress(_as_u8p(src), n, _as_u8p(dst), cap, itemsize, n_threads)
+    dst = ctypes.create_string_buffer(cap)
+    got = lib.psc_compress(
+        _as_const_u8p(data),
+        n,
+        ctypes.cast(dst, ctypes.POINTER(ctypes.c_uint8)),
+        cap,
+        itemsize,
+        n_threads,
+    )
     if got == 0 and n > 0:
         raise RuntimeError("psc_compress failed")
-    return b"N" + bytes(dst[:got])
+    # the input (checkpoint-sized) is passed zero-copy above; copying the
+    # compressed OUTPUT once here is the cheap side of the trade
+    return b"N" + ctypes.string_at(dst, got)
 
 
 def decompress_bytes(blob: bytes, n_threads: int = 0) -> bytes:
@@ -128,8 +142,8 @@ def decompress_bytes(blob: bytes, n_threads: int = 0) -> bytes:
         raise RuntimeError(
             "blob was written by the native codec but the library is unavailable"
         )
-    src = bytearray(payload) if payload else bytearray(1)
-    raw = lib.psc_raw_size(_as_u8p(src), len(payload))
+    src = _as_const_u8p(payload)
+    raw = lib.psc_raw_size(src, len(payload))
     if raw == 0:
         # raw==0 is either a genuinely empty stream or a bad header —
         # disambiguate by validating the header here
@@ -142,7 +156,7 @@ def decompress_bytes(blob: bytes, n_threads: int = 0) -> bytes:
             return b""
         raise ValueError("malformed psnative stream")
     dst = bytearray(raw)
-    got = lib.psc_decompress(_as_u8p(src), len(payload), _as_u8p(dst), raw, n_threads)
+    got = lib.psc_decompress(src, len(payload), _as_u8p(dst), raw, n_threads)
     if got != raw:
         raise ValueError("corrupt psnative stream")
     return bytes(dst)

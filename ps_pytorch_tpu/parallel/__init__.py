@@ -1,6 +1,8 @@
 """Parallelism layer: device mesh (replaces the MPI star topology),
-PS data-parallel engine (replaces master/worker runtimes), and
-aggregation collectives (replace the Irecv/waitany/Blosc gather path)."""
+PS data-parallel engine (replaces master/worker runtimes), aggregation
+collectives (replace the Irecv/waitany/Blosc gather path), and ring
+attention for sequence/context parallelism (long-context support beyond
+the reference's scope)."""
 
 from .collectives import (
     aggregate_gradients,
@@ -14,6 +16,14 @@ from .mesh import (
     initialize_multihost,
     make_mesh,
     replicated_sharding,
+)
+from .ring_attention import (
+    SEQ_AXIS,
+    full_attention,
+    make_ring_attention,
+    make_seq_mesh,
+    ring_attention,
+    shard_sequence,
 )
 from .ps import (
     PSConfig,

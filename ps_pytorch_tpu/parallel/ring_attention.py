@@ -1,0 +1,156 @@
+"""Ring attention — sequence/context parallelism over a mesh axis.
+
+Long-context support is out of the reference's scope (SURVEY.md section 5:
+CNN image workloads only, "no attention, no sequence dimension anywhere"),
+but it is first-class here: the same ICI ring that carries the PS gradient
+collectives carries blockwise attention, so sequences scale with the mesh
+instead of with one chip's HBM.
+
+Algorithm (blockwise online softmax, flash-attention style accumulation):
+each of the N devices holds a [B, T/N, H, D] shard of Q/K/V. K/V blocks
+rotate around the ring with `lax.ppermute` (neighbor exchange over ICI —
+N-1 hops total, each overlapped by XLA with the local QK^T/PV compute);
+every hop updates a running (max m, denominator l, numerator o) triple, so
+softmax is exact without ever materializing the [T, T] score matrix.
+Causality is enforced per (query-block, key-block) pair from the devices'
+ring positions — fully-masked pairs contribute nothing and skip no hops
+(uniform control flow keeps the loop compilable).
+
+The N=1 degenerate case is exact full attention; tests check the sharded
+result against it bit-for-tolerance on the virtual CPU mesh.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+SEQ_AXIS = "seq"
+
+_NEG_BIG = -1e30  # mask value; avoids -inf - -inf = nan in the max trick
+
+
+def _block_attend(q, k, v, mask, scale):
+    """One (query-block x key-block) contribution.
+
+    q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; mask: [Tq, Tk] bool or None.
+    Returns (m_blk [B, H, Tq], p_sum [B, H, Tq], pv [B, Tq, H, D]).
+    """
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if mask is not None:
+        scores = jnp.where(mask[None, None], scores, _NEG_BIG)
+    m_blk = jnp.max(scores, axis=-1)  # [B, H, Tq]
+    p = jnp.exp(scores - m_blk[..., None])
+    if mask is not None:
+        # rows with no valid key: m_blk == _NEG_BIG and p would be exp(0)=1
+        p = jnp.where(mask[None, None], p, 0.0)
+    p_sum = jnp.sum(p, axis=-1)
+    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    return m_blk, p_sum, pv
+
+
+def ring_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = SEQ_AXIS,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention over sequence shards rotating on a ring.
+
+    Call inside shard_map with q/k/v sharded [B, T_local, H, D] along the
+    sequence axis `axis_name`. Returns the local output shard.
+    """
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, t_loc, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+
+    # send my k/v block to the PREVIOUS device each hop: after s hops,
+    # device i holds key block (i + s) mod n
+    perm = [(j, (j - 1) % n) for j in range(n)]
+
+    q_pos = me * t_loc + jnp.arange(t_loc)  # global query positions
+
+    def hop(carry, s):
+        o, m, l, k_cur, v_cur = carry
+        k_blk = (me + s) % n
+        if causal:
+            k_pos = k_blk * t_loc + jnp.arange(t_loc)
+            mask = k_pos[None, :] <= q_pos[:, None]  # [Tq, Tk]
+        else:
+            mask = None
+        m_blk, p_sum, pv = _block_attend(q, k_cur, v_cur, mask, scale)
+        m_new = jnp.maximum(m, m_blk)
+        alpha = jnp.exp(m - m_new)  # rescale old accumulators
+        beta = jnp.exp(m_blk - m_new)  # rescale this block
+        l_new = l * alpha + p_sum * beta
+        o_new = (
+            o * alpha.transpose(0, 2, 1)[..., None]
+            + pv * beta.transpose(0, 2, 1)[..., None]
+        )
+        # uniform rotation every hop keeps the loop body identical for XLA
+        # (the final hop's permute returns k/v to their home devices)
+        k_nxt = lax.ppermute(k_cur, axis_name, perm)
+        v_nxt = lax.ppermute(v_cur, axis_name, perm)
+        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+
+    o0 = jnp.zeros_like(q)
+    m0 = jnp.full((b, h, t_loc), _NEG_BIG, q.dtype)
+    l0 = jnp.zeros((b, h, t_loc), q.dtype)
+    # scan (not fori_loop): reverse-mode AD must flow through the ring for
+    # training; ppermute transposes to the inverse rotation in the backward
+    (o, m, l, _, _), _ = lax.scan(hop, (o0, m0, l0, k, v), jnp.arange(n))
+    # causal guarantees >= 1 valid key per query (its own position), so l > 0
+    return o / l.transpose(0, 2, 1)[..., None]
+
+
+def full_attention(
+    q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Single-device reference: exact softmax attention, [B, T, H, D]."""
+    d = q.shape[-1]
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    if causal:
+        t = q.shape[1]
+        mask = jnp.tril(jnp.ones((t, t), bool))
+        scores = jnp.where(mask[None, None], scores, _NEG_BIG)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+
+
+def make_seq_mesh(num_shards: Optional[int] = None) -> Mesh:
+    """1-D sequence-parallel mesh (axis 'seq')."""
+    from .mesh import make_mesh
+
+    return make_mesh(num_workers=num_shards, axis_name=SEQ_AXIS)
+
+
+def make_ring_attention(
+    mesh: Mesh, axis_name: str = SEQ_AXIS, causal: bool = False
+):
+    """Jitted sequence-sharded attention: (q, k, v) [B, T, H, D] global ->
+    [B, T, H, D] global, T sharded over the mesh axis."""
+    mapped = jax.shard_map(
+        partial(ring_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
+        out_specs=P(None, axis_name),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def shard_sequence(x: jax.Array, mesh: Mesh, axis_name: str = SEQ_AXIS):
+    """Place [B, T, ...] with T sharded along the mesh axis."""
+    return jax.device_put(x, NamedSharding(mesh, P(None, axis_name)))
