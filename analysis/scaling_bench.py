@@ -1,0 +1,125 @@
+"""Scaling benchmark: PS train-step throughput vs mesh size.
+
+Produces the curve the reference publishes (BASELINE.md: speedup vs
+1/2/4/8/16/32 workers on LeNet b=8192 and ResNet-18 b=1024/2048/4096) from
+THIS framework, by timing the jitted PS step over meshes of increasing
+size. Weak scaling (per-worker batch fixed, the reference's setup) is the
+default; --strong divides a fixed global batch instead.
+
+On real multi-chip hardware this measures ICI collectives; on a virtual
+CPU mesh (JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8)
+it validates the curve's shape and the harness itself — the output records
+which platform produced it, so nobody mistakes one for the other.
+
+  python -m analysis.scaling_bench --network LeNet --batch-size 1024 \
+      --workers 1 2 4 8 --steps 20 --json out.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import time
+
+import numpy as np
+
+
+def bench_mesh(network, dataset, num_workers, per_worker_batch, steps, compress):
+    import jax
+
+    from ps_pytorch_tpu.data import IMAGE_SHAPES, make_preprocessor
+    from ps_pytorch_tpu.models import build_model
+    from ps_pytorch_tpu.optim import build_optimizer
+    from ps_pytorch_tpu.parallel import (
+        PSConfig,
+        init_ps_state,
+        make_mesh,
+        make_ps_train_step,
+        shard_batch,
+        shard_state,
+    )
+
+    mesh = make_mesh(num_workers=num_workers)
+    cfg = PSConfig(
+        num_workers=num_workers, compress="int8" if compress else None
+    )
+    model = build_model(network)
+    tx = build_optimizer("sgd", 0.01, momentum=0.9)
+    shape = IMAGE_SHAPES[dataset]
+    state = init_ps_state(model, tx, cfg, jax.random.key(0), shape)
+    state = shard_state(state, mesh, cfg)
+    step = make_ps_train_step(
+        model, tx, cfg, mesh, preprocess=make_preprocessor(dataset, train=True)
+    )
+    global_batch = per_worker_batch * num_workers
+    rng = np.random.RandomState(0)
+    batch = {
+        "image": rng.randint(0, 255, (global_batch,) + shape).astype(np.uint8),
+        "label": rng.randint(0, 10, (global_batch,)).astype(np.int32),
+    }
+    sharded = shard_batch(batch, mesh, cfg)
+    key = jax.random.key(1)
+    for _ in range(2):  # compile + settle
+        state, m = step(state, sharded, key)
+    jax.block_until_ready(state.params)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, m = step(state, sharded, key)
+    jax.block_until_ready(state.params)
+    dt = time.perf_counter() - t0
+    return {
+        "workers": num_workers,
+        "global_batch": global_batch,
+        "step_time_s": round(dt / steps, 6),
+        "images_per_sec": round(global_batch * steps / dt, 1),
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("analysis.scaling_bench")
+    p.add_argument("--network", default="LeNet")
+    p.add_argument("--dataset", default="MNIST")
+    p.add_argument("--batch-size", type=int, default=1024,
+                   help="per-worker batch (weak scaling, reference setup)")
+    p.add_argument("--workers", type=int, nargs="+", default=[1, 2, 4, 8])
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--strong", action="store_true",
+                   help="fixed global batch divided across workers")
+    p.add_argument("--compress", action="store_true",
+                   help="int8-quantized gradient collectives")
+    p.add_argument("--json", default=None, help="also write results to this file")
+    args = p.parse_args(argv)
+
+    import jax
+
+    rows = []
+    for w in args.workers:
+        pw = args.batch_size // w if args.strong else args.batch_size
+        rows.append(
+            bench_mesh(args.network, args.dataset, w, pw, args.steps, args.compress)
+        )
+        print(rows[-1], flush=True)
+    base = rows[0]
+    for r in rows:
+        thr = r["images_per_sec"] / base["images_per_sec"]
+        r["speedup_vs_first"] = round(thr, 3)
+        r["scaling_efficiency"] = round(
+            thr / (r["workers"] / base["workers"]), 3
+        )
+    result = {
+        "platform": jax.devices()[0].platform,
+        "device_kind": jax.devices()[0].device_kind,
+        "network": args.network,
+        "mode": "strong" if args.strong else "weak",
+        "per_worker_batch": args.batch_size,
+        "rows": rows,
+    }
+    print(json.dumps(result))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=2)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,127 @@
+"""Speedup analysis from training logs — the reference's notebook layer as code.
+
+Parity target: analysis/Speedup_Comparisons_LeNet.ipynb and
+Speedups_with_GradCompression.ipynb in /root/reference regex-parse per-
+iteration worker log lines, then for every step take the SLOWEST worker's
+time ("normal": the straggler-bound step time the synchronous protocol
+actually pays) and the FASTEST ("ideal": straggler-free), sum over steps,
+and divide a baseline run's total by each run's total to get speedup curves
+(notebook cell 5). tiny_tuning_parser.py does the same scrape to average
+losses.
+
+This module does the identical computation from this framework's log lines
+(utils.parse_iter_line understands both our format and the reference's), as
+a library + CLI instead of a notebook:
+
+  python -m analysis.speedup --baseline logs/w1.log logs/w2.log logs/w4.log
+
+Under SPMD there is one log line per global step (the mesh is one worker
+collective), so "normal" == "ideal" unless logs come from multiple hosts —
+the distinction is kept so reference logs parse identically.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+from dataclasses import dataclass, field
+from typing import Dict, List, Optional
+
+from ps_pytorch_tpu.utils import parse_iter_line
+
+
+@dataclass
+class RunStats:
+    path: str
+    steps: Dict[int, List[float]]  # step -> per-worker time costs
+    losses: List[float] = field(default_factory=list)
+
+    @property
+    def total_normal(self) -> float:
+        """Straggler-bound total: slowest worker per step (notebook 'normal')."""
+        return sum(max(v) for v in self.steps.values())
+
+    @property
+    def total_ideal(self) -> float:
+        """Straggler-free total: fastest worker per step (notebook 'ideal')."""
+        return sum(min(v) for v in self.steps.values())
+
+    @property
+    def mean_loss(self) -> Optional[float]:
+        """Average reported loss (tiny_tuning_parser.py semantics)."""
+        return sum(self.losses) / len(self.losses) if self.losses else None
+
+
+def parse_log(path: str, max_step: Optional[int] = None) -> RunStats:
+    steps: Dict[int, List[float]] = {}
+    losses: List[float] = []
+    with open(path, "r", errors="replace") as f:
+        for line in f:
+            d = parse_iter_line(line)
+            if d is None:
+                continue
+            step = int(d["step"])
+            if max_step is not None and step > max_step:
+                continue
+            steps.setdefault(step, []).append(d["time_cost"])
+            losses.append(d["loss"])
+    return RunStats(path=path, steps=steps, losses=losses)
+
+
+def speedups(runs: List[RunStats], baseline: RunStats) -> List[dict]:
+    """Speedup of each run vs the baseline (notebook cell 5 math)."""
+    out = []
+    for r in runs:
+        out.append(
+            {
+                "log": r.path,
+                "steps": len(r.steps),
+                "total_s": round(r.total_normal, 4),
+                "speedup": (
+                    round(baseline.total_normal / r.total_normal, 4)
+                    if r.total_normal
+                    else None
+                ),
+                "ideal_speedup": (
+                    round(baseline.total_ideal / r.total_ideal, 4)
+                    if r.total_ideal
+                    else None
+                ),
+            }
+        )
+    return out
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("analysis.speedup")
+    p.add_argument("logs", nargs="+", help="per-configuration log files")
+    p.add_argument("--baseline", default=None,
+                   help="baseline log (default: first positional)")
+    p.add_argument("--max-step", type=int, default=None,
+                   help="only count steps <= N (notebooks use 100)")
+    p.add_argument("--json", action="store_true", help="print JSON instead of a table")
+    args = p.parse_args(argv)
+
+    runs = [parse_log(path, args.max_step) for path in args.logs]
+    if args.baseline is None:
+        baseline = runs[0]
+    else:
+        by_path = {r.path: r for r in runs}
+        baseline = by_path.get(args.baseline) or parse_log(
+            args.baseline, args.max_step
+        )
+    rows = speedups(runs, baseline)
+    if args.json:
+        print(json.dumps(rows))
+    else:
+        print(f"{'log':40} {'steps':>6} {'total_s':>10} {'speedup':>8} {'ideal':>8}")
+        for r in rows:
+            print(
+                f"{r['log']:40} {r['steps']:>6} {r['total_s']:>10} "
+                f"{r['speedup']!s:>8} {r['ideal_speedup']!s:>8}"
+            )
+    return rows
+
+
+if __name__ == "__main__":
+    main()

@@ -59,8 +59,16 @@ def initialize_multihost(
     process_id: Optional[int] = None,
 ) -> None:
     """Join a multi-host training job over DCN (replaces mpirun's process
-    spawn + rendezvous, run_pytorch.sh:1). No-op for single-process runs."""
+    spawn + rendezvous, run_pytorch.sh:1). No-op for single-process runs.
+
+    Pass "auto" on Cloud TPU pods: jax.distributed.initialize() with no
+    arguments discovers the coordinator and process ids from the TPU
+    metadata service — every host runs the identical command (tools/
+    run_multihost.sh relies on this)."""
     if coordinator_address is None:
+        return
+    if coordinator_address == "auto":
+        jax.distributed.initialize()
         return
     jax.distributed.initialize(
         coordinator_address=coordinator_address,

@@ -1,0 +1,83 @@
+"""Analysis layer: log parsing -> speedup curves (notebook-parity math),
+scaling bench harness, and prepare_data CLI offline behavior."""
+
+import numpy as np
+
+from ps_pytorch_tpu.utils import format_iter_line
+
+
+def _write_log(path, worker_times):
+    """worker_times: {step: [t_worker0, t_worker1, ...]}"""
+    with open(path, "w") as f:
+        for step, times in worker_times.items():
+            for w, t in enumerate(times):
+                f.write(
+                    "INFO: "
+                    + format_iter_line(
+                        rank=w, step=step, epoch=1, seen=0, total=100,
+                        loss=2.0, time_cost=t,
+                    )
+                    + "\n"
+                )
+
+
+def test_speedup_math_matches_notebook_semantics(tmp_path):
+    from analysis.speedup import parse_log, speedups
+
+    # baseline: 1 worker, 1.0s/step x 4 steps = 4.0s total
+    base = tmp_path / "w1.log"
+    _write_log(base, {s: [1.0] for s in range(1, 5)})
+    # 4 workers: slowest 0.5, fastest 0.25 per step
+    four = tmp_path / "w4.log"
+    _write_log(four, {s: [0.25, 0.3, 0.4, 0.5] for s in range(1, 5)})
+
+    b = parse_log(str(base))
+    r = parse_log(str(four))
+    assert b.total_normal == 4.0
+    assert r.total_normal == 2.0  # straggler-bound: max per step
+    assert r.total_ideal == 1.0  # ideal: min per step
+    rows = speedups([b, r], b)
+    assert rows[1]["speedup"] == 2.0
+    assert rows[1]["ideal_speedup"] == 4.0
+    # mean_loss averages LOSSES (2.0 in every line), not step times
+    assert b.mean_loss == 2.0 and r.mean_loss == 2.0
+
+
+def test_speedup_cli(tmp_path, capsys):
+    from analysis.speedup import main
+
+    log = tmp_path / "a.log"
+    _write_log(log, {1: [0.5], 2: [0.5]})
+    rows = main([str(log), "--json"])
+    assert rows[0]["speedup"] == 1.0
+    assert "speedup" in capsys.readouterr().out
+
+
+def test_speedup_max_step_filter(tmp_path):
+    from analysis.speedup import parse_log
+
+    log = tmp_path / "a.log"
+    _write_log(log, {1: [1.0], 2: [1.0], 150: [99.0]})
+    assert parse_log(str(log), max_step=100).total_normal == 2.0
+
+
+def test_scaling_bench_two_points():
+    from analysis.scaling_bench import main
+
+    result = main(
+        ["--network", "LeNet", "--batch-size", "8", "--workers", "1", "2",
+         "--steps", "2"]
+    )
+    assert result["platform"] == "cpu"
+    assert len(result["rows"]) == 2
+    assert result["rows"][0]["speedup_vs_first"] == 1.0
+    assert all(np.isfinite(r["images_per_sec"]) for r in result["rows"])
+
+
+def test_prepare_data_offline(tmp_path, monkeypatch):
+    from ps_pytorch_tpu.cli.prepare_data import main
+
+    monkeypatch.setenv("PS_TPU_DATA_DIR", str(tmp_path))
+    # zero-egress: downloads fail, nothing on disk -> reports missing
+    status = main(["--datasets", "MNIST", "--data-root", str(tmp_path)])
+    assert status == {"MNIST": False}

@@ -1,0 +1,9 @@
+#!/usr/bin/env bash
+# Tear down the pod slice (parity: the reference's EC2 terminate path in
+# tools/pytorch_ec2.py).
+set -euo pipefail
+
+TPU_NAME=${TPU_NAME:-ps-tpu-pod}
+ZONE=${ZONE:-us-central2-b}
+
+gcloud compute tpus tpu-vm delete "${TPU_NAME}" --zone="${ZONE}" --quiet

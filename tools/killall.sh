@@ -1,0 +1,10 @@
+#!/usr/bin/env bash
+# Kill all training processes on every pod host (parity: tools/killall.sh
+# in the reference, which pkill'd python over the ssh mesh).
+set -euo pipefail
+
+TPU_NAME=${TPU_NAME:-ps-tpu-pod}
+ZONE=${ZONE:-us-central2-b}
+
+gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone="${ZONE}" --worker=all \
+  --command="pkill -f ps_pytorch_tpu.cli || true"

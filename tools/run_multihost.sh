@@ -1,0 +1,26 @@
+#!/usr/bin/env bash
+# Launch distributed PS training on every host of a TPU pod slice.
+#
+# Role parity with the reference's src/run_pytorch.sh (mpirun -n P+1
+# --hostfile hosts_address ... distributed_nn.py). There is no mpirun: each
+# TPU VM host runs the SAME command; jax.distributed discovers peers via
+# the TPU metadata service, and the mesh spans all chips in the slice.
+# Extra flags after the script name are forwarded to the trainer CLI.
+#
+# Usage:
+#   TPU_NAME=ps-pod ZONE=us-central2-b tools/run_multihost.sh \
+#       --network ResNet18 --dataset Cifar10 --batch-size 128 --lr 0.1 \
+#       --momentum 0.9 --num-aggregate 5 --compress-grad compress
+set -euo pipefail
+
+TPU_NAME=${TPU_NAME:-ps-tpu-pod}
+ZONE=${ZONE:-us-central2-b}
+
+# shell-quote each forwarded arg so spaces survive the ssh round trip
+ARGS=$(printf '%q ' "$@")
+
+# --coordinator-address auto: every host runs this same command and
+# jax.distributed.initialize() discovers the pod topology, forming ONE mesh
+# across all hosts (parallel/mesh.py:initialize_multihost)
+gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone="${ZONE}" --worker=all \
+  --command="cd ps_pytorch_tpu_repo && python -m ps_pytorch_tpu.cli.train --coordinator-address auto ${ARGS}"
