@@ -69,6 +69,7 @@ def bench_mesh(network, dataset, num_workers, per_worker_batch, steps, compress)
     dt = time.perf_counter() - t0
     return {
         "workers": num_workers,
+        "per_worker_batch": per_worker_batch,
         "global_batch": global_batch,
         "step_time_s": round(dt / steps, 6),
         "images_per_sec": round(global_batch * steps / dt, 1),
@@ -111,7 +112,9 @@ def main(argv=None):
         "device_kind": jax.devices()[0].device_kind,
         "network": args.network,
         "mode": "strong" if args.strong else "weak",
-        "per_worker_batch": args.batch_size,
+        # strong mode: --batch-size is the fixed GLOBAL batch; weak mode:
+        # the per-worker batch. Per-row per_worker_batch is authoritative.
+        "batch_size_arg": args.batch_size,
         "rows": rows,
     }
     print(json.dumps(result))

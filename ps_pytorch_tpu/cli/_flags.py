@@ -73,6 +73,9 @@ def add_ps_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="compress -> int8-quantized gradient collectives")
     parser.add_argument("--quant-block-size", type=int, default=0,
                         help="per-block quantization scale granularity (0 = per-tensor)")
+    parser.add_argument("--quant-rounding", type=str, default="nearest",
+                        choices=("nearest", "stochastic"),
+                        help="stochastic = unbiased gradient quantization")
     parser.add_argument("--opt-placement", type=str, default="replicated",
                         choices=("replicated", "sharded"),
                         help="where optimizer state lives (sharded = ZeRO-1 PS)")
@@ -117,6 +120,7 @@ def ps_config_from(args: argparse.Namespace, num_workers: int) -> PSConfig:
         mask_mode=args.mask_mode,
         compress="int8" if args.compress_grad == "compress" else None,
         quant_block_size=args.quant_block_size,
+        quant_rounding=args.quant_rounding,
         opt_placement=args.opt_placement,
         bn_mode=args.bn_mode,
     )

@@ -4,18 +4,27 @@ compression.py:18-31, snappy codec at :20).
 
 A lossless byte codec is pointless inside XLA programs; the *capability* being
 matched is bandwidth reduction on the gradient path (4x for int8), wired into
-the collective in parallel/collectives.py. Two implementations:
+the collective in parallel/collectives.py. Implementations:
 
-- a pure-jnp reference (runs anywhere, used on the virtual CPU test mesh), and
-- a Pallas TPU kernel fusing scale-multiply + round + clip + int8 cast on the
-  VPU (8x128 lanes), selected automatically on TPU backends.
+- a pure-jnp reference (runs anywhere; used on the virtual CPU test mesh),
+- Pallas TPU kernels (per-tensor and per-block) fusing scale-multiply +
+  round + clip + int8 cast on the VPU (8x128 lanes), selected automatically
+  on TPU backends and exercised on CPU via PS_TPU_PALLAS_INTERPRET=1
+  (pallas interpret mode).
 
-Scales are symmetric absmax/127, per-tensor (block_size=0) or per-block of the
-flattened tensor (block_size>0, tighter error). When `axis_name` is given the
-absmax is pmax'd across that mesh axis so every worker quantizes with the SAME
-scale — which is what makes the int32 psum of quantized values an exact sum of
-the per-worker quantizations (determinism the reference's per-worker Blosc
-streams cannot offer).
+Rounding: "nearest" (default) or "stochastic" — stochastic rounding makes
+the quantizer unbiased (E[deq(q(x))] = x), which matters for gradient
+aggregation: nearest-rounding bias accumulates over steps, stochastic noise
+averages out across workers and time. Stochastic mode needs a PRNG key and
+runs on the jnp path (XLA fuses it; the Pallas kernel covers the nearest
+hot path).
+
+Scales are symmetric absmax/127, per-tensor (block_size=0) or per-block of
+the flattened tensor (block_size>0, tighter error). When `axis_name` is
+given the absmax is pmax'd across that mesh axis so every worker quantizes
+with the SAME scale — which is what makes the int32 psum of quantized
+values an exact sum of the per-worker quantizations (determinism the
+reference's per-worker Blosc streams cannot offer).
 """
 
 from __future__ import annotations
@@ -32,13 +41,18 @@ _LANE = 128
 _SUBLANE = 8
 
 
-def _use_pallas(x: jax.Array) -> bool:
+def _pallas_mode(x: jax.Array) -> Optional[dict]:
+    """None = use jnp; otherwise kwargs for pl.pallas_call."""
     if os.environ.get("PS_TPU_DISABLE_PALLAS"):
-        return False
-    return jax.default_backend() == "tpu" and x.size >= _LANE * _SUBLANE
+        return None
+    if os.environ.get("PS_TPU_PALLAS_INTERPRET"):
+        return {"interpret": True}
+    if jax.default_backend() == "tpu" and x.size >= _LANE * _SUBLANE:
+        return {}
+    return None
 
 
-# ------------------------------------------------------------- pallas kernel
+# ------------------------------------------------------------ pallas kernels
 
 
 def _quant_kernel(x_ref, inv_ref, out_ref):
@@ -47,14 +61,20 @@ def _quant_kernel(x_ref, inv_ref, out_ref):
     ).astype(jnp.int8)
 
 
-def _pallas_quantize_2d(x2: jax.Array, inv_scale: jax.Array) -> jax.Array:
-    """x2: f32 [M, 128] with M % 8 == 0. inv_scale: f32 scalar -> int8 [M, 128]."""
+def _quant_rows_kernel(x_ref, inv_ref, out_ref):
+    # per-row (= per-quantization-block) scales: inv_ref is [block_rows, 1]
+    out_ref[:] = jnp.clip(
+        jnp.round(x_ref[:] * inv_ref[:]), -127.0, 127.0
+    ).astype(jnp.int8)
+
+
+def _pallas_quantize_2d(x2: jax.Array, inv_scale: jax.Array, mode: dict) -> jax.Array:
+    """x2: f32 [M, 128], M % 8 == 0; inv_scale: f32 scalar -> int8 [M, 128]."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     m = x2.shape[0]
     block_m = min(m, 1024)
-    # grid over row-blocks; last partial block is masked by pallas automatically
     return pl.pallas_call(
         _quant_kernel,
         out_shape=jax.ShapeDtypeStruct((m, _LANE), jnp.int8),
@@ -63,17 +83,56 @@ def _pallas_quantize_2d(x2: jax.Array, inv_scale: jax.Array) -> jax.Array:
             pl.BlockSpec((block_m, _LANE), lambda i: (i, 0), memory_space=pltpu.VMEM),
             pl.BlockSpec(memory_space=pltpu.SMEM),
         ],
-        out_specs=pl.BlockSpec((block_m, _LANE), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        out_specs=pl.BlockSpec(
+            (block_m, _LANE), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        **mode,
     )(x2, inv_scale.reshape(1, 1))
 
 
+def _pallas_quantize_rows(xb: jax.Array, inv: jax.Array, mode: dict) -> jax.Array:
+    """xb: f32 [NB, BS] (BS % 128 == 0), inv: f32 [NB, 1] -> int8 [NB, BS]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    nb, bs = xb.shape
+    block_nb = min(nb, max(_SUBLANE, 4096 // (bs // _LANE)))
+    block_nb = -(-block_nb // _SUBLANE) * _SUBLANE  # sublane-align the tile
+    return pl.pallas_call(
+        _quant_rows_kernel,
+        out_shape=jax.ShapeDtypeStruct((nb, bs), jnp.int8),
+        grid=(pl.cdiv(nb, block_nb),),
+        in_specs=[
+            pl.BlockSpec((block_nb, bs), lambda i: (i, 0), memory_space=pltpu.VMEM),
+            pl.BlockSpec((block_nb, 1), lambda i: (i, 0), memory_space=pltpu.VMEM),
+        ],
+        out_specs=pl.BlockSpec(
+            (block_nb, bs), lambda i: (i, 0), memory_space=pltpu.VMEM
+        ),
+        **mode,
+    )(xb, inv)
+
+
 # ---------------------------------------------------------------- public API
+
+
+def _round(x: jax.Array, rounding: str, key: Optional[jax.Array]) -> jax.Array:
+    if rounding == "nearest":
+        return jnp.round(x)
+    if rounding == "stochastic":
+        if key is None:
+            raise ValueError("stochastic rounding needs a PRNG key")
+        # floor(x + U[0,1)): P(round up) == frac(x) -> unbiased
+        return jnp.floor(x + jax.random.uniform(key, x.shape, jnp.float32))
+    raise ValueError(f"unknown rounding {rounding!r}")
 
 
 def quantize_int8(
     x: jax.Array,
     axis_name: Optional[str] = None,
     block_size: int = 0,
+    rounding: str = "nearest",
+    key: Optional[jax.Array] = None,
 ) -> Tuple[jax.Array, jax.Array]:
     """Symmetric int8 quantization.
 
@@ -83,6 +142,7 @@ def quantize_int8(
     ``dequantize_int8`` to undo.
     """
     x = x.astype(jnp.float32)
+    mode = _pallas_mode(x) if rounding == "nearest" else None
     if block_size:
         flat = x.reshape(-1)
         n = flat.shape[0]
@@ -94,7 +154,10 @@ def quantize_int8(
             absmax = lax.pmax(absmax, axis_name)
         scale = absmax / 127.0
         inv = jnp.where(absmax > 0, 127.0 / jnp.maximum(absmax, 1e-30), 0.0)
-        q = jnp.clip(jnp.round(xb * inv), -127, 127).astype(jnp.int8)
+        if mode is not None and block_size % _LANE == 0 and nb % _SUBLANE == 0:
+            q = _pallas_quantize_rows(xb, inv, mode)
+        else:
+            q = jnp.clip(_round(xb * inv, rounding, key), -127, 127).astype(jnp.int8)
         return q, scale
 
     absmax = jnp.max(jnp.abs(x))
@@ -102,15 +165,15 @@ def quantize_int8(
         absmax = lax.pmax(absmax, axis_name)
     scale = absmax / 127.0
     inv = jnp.where(absmax > 0, 127.0 / jnp.maximum(absmax, 1e-30), 0.0)
-    if _use_pallas(x):
+    if mode is not None:
         n = x.size
         rows = -(-n // _LANE)
         rows_pad = -(-rows // _SUBLANE) * _SUBLANE
         flat = jnp.pad(x.reshape(-1), (0, rows_pad * _LANE - n))
-        q2 = _pallas_quantize_2d(flat.reshape(rows_pad, _LANE), inv)
+        q2 = _pallas_quantize_2d(flat.reshape(rows_pad, _LANE), inv, mode)
         q = q2.reshape(-1)[:n].reshape(x.shape)
     else:
-        q = jnp.clip(jnp.round(x * inv), -127, 127).astype(jnp.int8)
+        q = jnp.clip(_round(x * inv, rounding, key), -127, 127).astype(jnp.int8)
     return q, scale
 
 

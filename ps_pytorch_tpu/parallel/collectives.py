@@ -61,24 +61,48 @@ def psum_mean(tree, axis_name: str, denominator: float):
     return jax.tree_util.tree_map(lambda g: g / denominator, summed)
 
 
-def quantized_psum(tree, axis_name: str, denominator: float, block_size: int = 0):
+def quantized_psum(
+    tree,
+    axis_name: str,
+    denominator: float,
+    block_size: int = 0,
+    rounding: str = "nearest",
+    key: Optional[jax.Array] = None,
+):
     """int8-quantized gradient all-reduce.
 
     Per leaf: global absmax (pmax) -> symmetric int8 quantize -> int32 psum
     -> dequantize / denominator. Deterministic (same scale on all workers) and
     exact-sum in int32 (no overflow below 2^23 workers). `block_size` > 0
-    switches to per-block scales for tighter quantization error (capability
-    beyond the reference's lossless-but-slow Blosc path).
+    switches to per-block scales for tighter quantization error; `rounding=
+    "stochastic"` makes each worker's quantization unbiased with independent
+    noise (key folded by worker index and leaf), so rounding error averages
+    out across the psum instead of accumulating (capabilities beyond the
+    reference's lossless-but-slow Blosc path).
     """
+    if rounding == "stochastic":
+        if key is None:
+            raise ValueError("stochastic rounding needs a key")
+        key = jax.random.fold_in(key, lax.axis_index(axis_name))
 
-    def one(g):
+    def one(i, g):
         g32 = g.astype(jnp.float32)
-        q, scale = quantize_int8(g32, axis_name=axis_name, block_size=block_size)
+        leaf_key = jax.random.fold_in(key, i) if key is not None else None
+        q, scale = quantize_int8(
+            g32,
+            axis_name=axis_name,
+            block_size=block_size,
+            rounding=rounding,
+            key=leaf_key,
+        )
         s = lax.psum(q.astype(jnp.int32), axis_name)
         deq = dequantize_int8(s, scale, block_size=block_size, shape=g.shape)
         return deq / denominator
 
-    return jax.tree_util.tree_map(one, tree)
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return jax.tree_util.tree_unflatten(
+        treedef, [one(i, g) for i, g in enumerate(leaves)]
+    )
 
 
 def aggregate_gradients(
@@ -90,6 +114,8 @@ def aggregate_gradients(
     mask_mode: str = "random_k",
     compress: Optional[str] = None,
     quant_block_size: int = 0,
+    quant_rounding: str = "nearest",
+    quant_key: Optional[jax.Array] = None,
 ):
     """The full PS aggregation: mask -> (quantized) psum -> / K."""
     k = (
@@ -103,5 +129,12 @@ def aggregate_gradients(
     if compress in (None, "none"):
         return psum_mean(grads, axis_name, float(k))
     if compress == "int8":
-        return quantized_psum(grads, axis_name, float(k), block_size=quant_block_size)
+        return quantized_psum(
+            grads,
+            axis_name,
+            float(k),
+            block_size=quant_block_size,
+            rounding=quant_rounding,
+            key=quant_key,
+        )
     raise ValueError(f"unknown compression {compress!r}")

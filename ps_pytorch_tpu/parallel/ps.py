@@ -67,6 +67,7 @@ class PSConfig:
     mask_mode: str = "random_k"
     compress: Optional[str] = None  # None | "int8"
     quant_block_size: int = 0
+    quant_rounding: str = "nearest"  # "nearest" | "stochastic" (unbiased)
     opt_placement: str = "replicated"  # "replicated" | "sharded"
     bn_mode: str = "pmean"  # "local" | "pmean" | "synced"
 
@@ -77,6 +78,8 @@ class PSConfig:
             raise ValueError(f"bad bn_mode {self.bn_mode!r}")
         if self.compress not in (None, "none", "int8"):
             raise ValueError(f"bad compress {self.compress!r}")
+        if self.quant_rounding not in ("nearest", "stochastic"):
+            raise ValueError(f"bad quant_rounding {self.quant_rounding!r}")
 
     @property
     def effective_aggregate(self) -> int:
@@ -171,7 +174,7 @@ def shard_batch(batch, mesh: Mesh, cfg: PSConfig):
     return jax.device_put(batch, NamedSharding(mesh, P(cfg.axis_name)))
 
 
-def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key):
+def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key, quant_key=None):
     """ZeRO-1 "sharded PS": mask -> (quantize) -> reduce_scatter -> per-shard
     optax update -> all_gather the parameter delta."""
     axis, n = cfg.axis_name, cfg.num_workers
@@ -184,7 +187,15 @@ def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key):
     shard = _zero1_shard_size(total, cfg)
     flat_g = jnp.pad(flat_g.astype(jnp.float32), (0, shard * n - total))
     if cfg.compress == "int8":
-        q, scale = quantize_int8(flat_g, axis_name=axis, block_size=cfg.quant_block_size)
+        if cfg.quant_rounding == "stochastic" and quant_key is not None:
+            quant_key = jax.random.fold_in(quant_key, lax.axis_index(axis))
+        q, scale = quantize_int8(
+            flat_g,
+            axis_name=axis,
+            block_size=cfg.quant_block_size,
+            rounding=cfg.quant_rounding,
+            key=quant_key,
+        )
         if cfg.quant_block_size:
             # per-block scales: scatter blocks, keep scale rows aligned
             qflat = q.reshape(-1)
@@ -250,7 +261,10 @@ def make_ps_train_step(
         )
 
         if cfg.opt_placement == "sharded":
-            params, new_opt = _sharded_ps_update(params, opt_state, grads, tx, cfg, k_mask)
+            params, new_opt = _sharded_ps_update(
+                params, opt_state, grads, tx, cfg, k_mask,
+                quant_key=jax.random.fold_in(k_step, 0x5E) if cfg.compress else None,
+            )
             new_opt = tree_map(lambda a: a[None], new_opt)
         else:
             agg = aggregate_gradients(
@@ -262,6 +276,8 @@ def make_ps_train_step(
                 mask_mode=cfg.mask_mode,
                 compress=cfg.compress,
                 quant_block_size=cfg.quant_block_size,
+                quant_rounding=cfg.quant_rounding,
+                quant_key=jax.random.fold_in(k_step, 0x5E) if cfg.compress else None,
             )
             updates, new_opt = tx.update(agg, opt_state, params)
             params = optax.apply_updates(params, updates)

@@ -75,9 +75,10 @@ def test_scaling_bench_two_points():
 
 
 def test_prepare_data_offline(tmp_path, monkeypatch):
-    from ps_pytorch_tpu.cli.prepare_data import main
+    import ps_pytorch_tpu.cli.prepare_data as pd
 
+    # simulate zero egress regardless of the host's actual connectivity
+    monkeypatch.setattr(pd, "download", lambda name, root: False)
     monkeypatch.setenv("PS_TPU_DATA_DIR", str(tmp_path))
-    # zero-egress: downloads fail, nothing on disk -> reports missing
-    status = main(["--datasets", "MNIST", "--data-root", str(tmp_path)])
+    status = pd.main(["--datasets", "MNIST", "--data-root", str(tmp_path)])
     assert status == {"MNIST": False}

@@ -224,3 +224,16 @@ def test_bad_configs():
         PSConfig(num_workers=4, bn_mode="global")
     with pytest.raises(ValueError):
         PSConfig(num_workers=4, compress="blosc")
+
+
+def test_stochastic_quantized_step_runs(mesh):
+    cfg = PSConfig(
+        num_workers=N, compress="int8", quant_rounding="stochastic",
+        quant_block_size=128,
+    )
+    model, tx, state, step = _lenet_setup(cfg, mesh)
+    state2, metrics = step(state, shard_batch(_batch(), mesh, cfg), jax.random.key(3))
+    assert np.isfinite(float(metrics["loss"]))
+    a0 = jax.tree_util.tree_leaves(jax.device_get(state.params))[0]
+    a1 = jax.tree_util.tree_leaves(jax.device_get(state2.params))[0]
+    assert not np.allclose(a0, a1)

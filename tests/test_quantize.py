@@ -68,3 +68,57 @@ def test_quantize_under_jit_and_grad_shapes():
 
     x = jax.random.normal(jax.random.key(2), (33, 65))
     assert f(x).shape == x.shape
+
+
+def test_pallas_kernels_in_interpret_mode(monkeypatch):
+    """Exercise the actual Pallas kernel code on CPU via interpret mode and
+    check it against the pure-jnp path."""
+    import numpy as np
+
+    from ps_pytorch_tpu.ops import quantize as qz
+
+    rng = np.random.RandomState(7)
+    x = jnp.asarray(rng.randn(33, 130).astype(np.float32))  # padding exercised
+
+    monkeypatch.delenv("PS_TPU_PALLAS_INTERPRET", raising=False)
+    monkeypatch.setenv("PS_TPU_DISABLE_PALLAS", "1")
+    q_ref, s_ref = qz.quantize_int8(x)
+    qb_ref, sb_ref = qz.quantize_int8(x, block_size=128)
+
+    monkeypatch.delenv("PS_TPU_DISABLE_PALLAS", raising=False)
+    monkeypatch.setenv("PS_TPU_PALLAS_INTERPRET", "1")
+    q_pl, s_pl = qz.quantize_int8(x)
+    qb_pl, sb_pl = qz.quantize_int8(x, block_size=128)
+
+    np.testing.assert_array_equal(np.asarray(q_ref), np.asarray(q_pl))
+    np.testing.assert_allclose(np.asarray(s_ref), np.asarray(s_pl))
+    np.testing.assert_array_equal(np.asarray(qb_ref), np.asarray(qb_pl))
+    np.testing.assert_allclose(np.asarray(sb_ref), np.asarray(sb_pl))
+
+
+def test_stochastic_rounding_unbiased():
+    import numpy as np
+
+    from ps_pytorch_tpu.ops.quantize import dequantize_int8, quantize_int8
+
+    # absmax element 1.0 fixes the grid; 0.4 then sits at 50.8 — off-grid,
+    # so nearest rounding biases every element the same way (+0.2 steps)
+    x = jnp.full((4096,), 0.4, jnp.float32).at[0].set(1.0)
+    qn, sn = quantize_int8(x)
+    bias_nearest = float(jnp.mean(dequantize_int8(qn, sn) - x))
+    # stochastic: mean error shrinks with averaging
+    errs = []
+    for seed in range(20):
+        qs, ss = quantize_int8(x, rounding="stochastic", key=jax.random.key(seed))
+        errs.append(float(jnp.mean(dequantize_int8(qs, ss) - x)))
+    bias_stoch = abs(float(np.mean(errs)))
+    # nearest is genuinely biased on this input; stochastic averages out
+    assert abs(bias_nearest) > 5e-4
+    assert bias_stoch < abs(bias_nearest) / 3
+    # every stochastic draw stays within one quantization step
+    assert all(abs(e) <= float(ss) for e in errs)
+
+
+def test_stochastic_requires_key():
+    with pytest.raises(ValueError):
+        quantize_int8(jnp.ones(8), rounding="stochastic")

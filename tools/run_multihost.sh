@@ -17,7 +17,9 @@ TPU_NAME=${TPU_NAME:-ps-tpu-pod}
 ZONE=${ZONE:-us-central2-b}
 
 # shell-quote each forwarded arg so spaces survive the ssh round trip
-ARGS=$(printf '%q ' "$@")
+# (skip entirely for zero args — printf would emit a spurious '')
+ARGS=""
+[ $# -gt 0 ] && ARGS=$(printf '%q ' "$@")
 
 # --coordinator-address auto: every host runs this same command and
 # jax.distributed.initialize() discovers the pod topology, forming ONE mesh
