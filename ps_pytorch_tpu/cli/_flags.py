@@ -52,6 +52,11 @@ def add_train_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="write checkpoints through the native C++ codec")
     parser.add_argument("--shard-mode", type=str, default=d.shard_mode,
                         choices=("reshuffle", "disjoint"))
+    parser.add_argument("--dtype", type=str, default=d.dtype,
+                        choices=("float32", "bfloat16"),
+                        help="compute dtype (bfloat16 = MXU-native; params stay f32)")
+    parser.add_argument("--profile-dir", type=str, default=None,
+                        help="write a jax.profiler trace of ~10 steps here")
     # accepted-for-parity flags (see module docstring)
     parser.add_argument("--mode", type=str, default="normal")
     parser.add_argument("--kill-threshold", type=float, default=7.0)
@@ -110,6 +115,8 @@ def train_config_from(args: argparse.Namespace) -> TrainConfig:
         data_root=args.data_root,
         allow_synthetic=not args.no_synthetic,
         shard_mode=args.shard_mode,
+        dtype=args.dtype,
+        profile_dir=args.profile_dir,
     )
 
 

@@ -154,7 +154,16 @@ def quantize_int8(
             absmax = lax.pmax(absmax, axis_name)
         scale = absmax / 127.0
         inv = jnp.where(absmax > 0, 127.0 / jnp.maximum(absmax, 1e-30), 0.0)
-        if mode is not None and block_size % _LANE == 0 and nb % _SUBLANE == 0:
+        # VMEM budget: an 8-sublane f32 tile of a huge block_size would not
+        # fit on chip (~16MB VMEM, double-buffered) — cap the tile at 2MB
+        # and fall back to jnp beyond it
+        fits_vmem = _SUBLANE * block_size * 4 <= 2 * 1024 * 1024
+        if (
+            mode is not None
+            and block_size % _LANE == 0
+            and nb % _SUBLANE == 0
+            and fits_vmem
+        ):
             q = _pallas_quantize_rows(xb, inv, mode)
         else:
             q = jnp.clip(_round(xb * inv, rounding, key), -127, 127).astype(jnp.int8)

@@ -1,0 +1,124 @@
+"""2-D parallelism: PS data parallelism x ring-attention sequence parallelism.
+
+The composition argument made executable: because the PS engine keeps params
+replicated (mesh.py docstring) and the sequence-parallel transformer keeps
+them replicated too (models/transformer.py), the two axes compose on one
+2-D mesh ("workers", "seq") with no weight re-sharding — batch shards ride
+the dp axis, sequence shards the sp axis, gradients meet in one
+pmean-over-dp + psum-over-sp.
+
+Gradient math: each (dp, sp) device differentiates only its LOCAL slice of
+the objective — loss_sum_local / count_global, with the global count a
+constant — and the gradients are psum'd over sp exactly once afterwards.
+Differentiating a psum'd loss inside shard_map would seed a cotangent on
+every sp device and overcount each term n_sp times (the ring's ppermute
+transpose already routes cross-device contributions back to the device
+owning the parameters' activation path). Averaging over dp is the PS
+aggregation (sync_replicas_master_nn.py:204-208 semantics, batch-mean form).
+
+Next-token targets cross sequence-shard boundaries: the target of a shard's
+last token is the NEXT shard's first token, fetched with one ppermute; the
+final global position is masked out of the loss.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.transformer import TransformerConfig, apply_transformer
+from .mesh import WORKER_AXIS
+from .ring_attention import SEQ_AXIS
+
+
+def make_mesh_2d(
+    num_dp: int,
+    num_sp: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+    dp_axis: str = WORKER_AXIS,
+    sp_axis: str = SEQ_AXIS,
+) -> Mesh:
+    """(num_dp x num_sp) mesh; dp outer so batch shards stay on neighboring
+    devices (the sp ring is the inner, highest-bandwidth dimension)."""
+    devs = list(devices if devices is not None else jax.devices())
+    need = num_dp * num_sp
+    if need > len(devs):
+        raise ValueError(f"need {need} devices, have {len(devs)}")
+    grid = np.array(devs[:need]).reshape(num_dp, num_sp)
+    return Mesh(grid, (dp_axis, sp_axis))
+
+
+def shard_tokens_2d(
+    tokens, mesh: Mesh, dp_axis: str = WORKER_AXIS, sp_axis: str = SEQ_AXIS
+):
+    """[B_global, T_global] -> B over dp, T over sp."""
+    return jax.device_put(tokens, NamedSharding(mesh, P(dp_axis, sp_axis)))
+
+
+def lm_loss_local(
+    cfg: TransformerConfig,
+    params,
+    tokens: jax.Array,
+    sp_axis: str = SEQ_AXIS,
+):
+    """LOCAL slice of the global-mean next-token loss for one (dp, sp) shard
+    of tokens [b_local, t_local].
+
+    Returns loss_sum_local / count_global. The global loss is the psum of
+    this over sp — do that OUTSIDE the differentiated function (see module
+    docstring: differentiating through the psum overcounts gradients)."""
+    b_loc, t_loc = tokens.shape
+    n_sp = lax.axis_size(sp_axis)
+    s = lax.axis_index(sp_axis)
+    logits = apply_transformer(cfg, params, tokens, seq_axis_name=sp_axis)
+    # target of my last token = next shard's first token (ring shift left)
+    nxt_first = lax.ppermute(
+        tokens[:, :1], sp_axis, [(j, (j - 1) % n_sp) for j in range(n_sp)]
+    )
+    tgt = jnp.concatenate([tokens[:, 1:], nxt_first], axis=1)
+    logp = jax.nn.log_softmax(logits.astype(jnp.float32), axis=-1)
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)[..., 0]
+    pos = s * t_loc + jnp.arange(t_loc)
+    valid = (pos < n_sp * t_loc - 1).astype(jnp.float32)  # drop final position
+    loss_sum = jnp.sum(nll * valid[None, :])
+    count = jnp.float32(b_loc) * jnp.sum(valid)
+    return loss_sum / lax.psum(count, sp_axis)
+
+
+def make_lm_train_step(
+    cfg: TransformerConfig,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    dp_axis: str = WORKER_AXIS,
+    sp_axis: str = SEQ_AXIS,
+):
+    """Jitted 2-D train step: (params, opt_state, tokens) ->
+    (params, opt_state, loss). params/opt_state replicated; tokens sharded
+    [B over dp, T over sp]."""
+
+    def worker_fn(params, opt_state, tokens):
+        loss_local, grads = jax.value_and_grad(
+            lambda p: lm_loss_local(cfg, p, tokens, sp_axis)
+        )(params)
+        # exact sequence gradient: sum local partials over sp exactly once;
+        # PS aggregation: mean over dp (each dp shard saw a disjoint slice)
+        grads = lax.pmean(lax.psum(grads, sp_axis), dp_axis)
+        loss = lax.pmean(lax.psum(loss_local, sp_axis), dp_axis)
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt, loss
+
+    mapped = jax.shard_map(
+        worker_fn,
+        mesh=mesh,
+        in_specs=(P(), P(), P(dp_axis, sp_axis)),
+        out_specs=(P(), P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped)

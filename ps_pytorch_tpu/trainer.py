@@ -87,6 +87,8 @@ class TrainConfig:
     data_root: Optional[str] = None
     allow_synthetic: bool = True
     shard_mode: str = "reshuffle"  # reference parity; "disjoint" improvement
+    dtype: str = "float32"  # compute dtype: float32 | bfloat16 (MXU-native)
+    profile_dir: Optional[str] = None  # jax.profiler trace output (eval_freq window)
 
 
 class Trainer:
@@ -98,9 +100,15 @@ class Trainer:
             tcfg.dataset, root=tcfg.data_root, allow_synthetic=tcfg.allow_synthetic
         )
         self.mesh = make_mesh(num_workers=pcfg.num_workers)
+        import jax.numpy as jnp
+
+        compute_dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[tcfg.dtype]
+        # compute in bf16 on the MXU when asked; params/optimizer state and
+        # the loss stay f32 (flax dtype= is the compute dtype only)
         self.model = build_model(
             tcfg.network,
             num_classes=self.dataset.num_classes,
+            dtype=compute_dtype,
             bn_axis_name=pcfg.axis_name if pcfg.bn_mode == "synced" else None,
         )
         self.tx = build_optimizer(
@@ -170,6 +178,19 @@ class Trainer:
         step_no = int(jax.device_get(self.state.step))
         timer = PhaseTimer()
         done = False
+        # profiler window: ~10 post-compile steps, parity role of the
+        # reference's per-phase wall spans but with real device timelines
+        # (SURVEY.md section 5 "tracing"; view with tensorboard/xprof)
+        steps_remaining = t.max_steps - step_no
+        if t.profile_dir and steps_remaining < 3:
+            logger.info(
+                "profile-dir set but only %d step(s) will run; profiling "
+                "starts after 2 warmup steps — no trace will be written",
+                steps_remaining,
+            )
+        profile_start = step_no + 2 if t.profile_dir else None
+        profile_stop = profile_start + 10 if t.profile_dir else None
+        profiling = False
         last_saved = None
         for epoch in range(1, t.epochs + 1):
             if done:
@@ -181,6 +202,13 @@ class Trainer:
                     # is a no-op instead of overshooting max_steps
                     done = True
                     break
+                if profile_start is not None and step_no + 1 == profile_start:
+                    jax.profiler.start_trace(t.profile_dir)
+                    profiling = True
+                elif profiling and step_no + 1 == profile_stop:
+                    jax.block_until_ready(self.state.params)
+                    jax.profiler.stop_trace()
+                    profiling = False
                 timer.reset()
                 with timer.phase("fetch"):
                     parts = [next(ei) for ei in epochs_iters]
@@ -219,6 +247,9 @@ class Trainer:
                 if step_no >= t.max_steps:
                     done = True
                     break
+        if profiling:  # run ended inside the window
+            jax.block_until_ready(self.state.params)
+            jax.profiler.stop_trace()
         if t.save_checkpoints and metrics and last_saved != step_no:
             ckpt.save_checkpoint(
                 jax.device_get(self.state),

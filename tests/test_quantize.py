@@ -78,17 +78,20 @@ def test_pallas_kernels_in_interpret_mode(monkeypatch):
     from ps_pytorch_tpu.ops import quantize as qz
 
     rng = np.random.RandomState(7)
-    x = jnp.asarray(rng.randn(33, 130).astype(np.float32))  # padding exercised
+    # 4290 elements: per-tensor path exercises the padding; per-block path
+    # needs nb % 8 == 0 to take the rows kernel, checked below
+    x = jnp.asarray(rng.randn(33, 130).astype(np.float32))
+    xb = jnp.asarray(rng.randn(32, 128).astype(np.float32))  # nb=32 -> rows kernel
 
     monkeypatch.delenv("PS_TPU_PALLAS_INTERPRET", raising=False)
     monkeypatch.setenv("PS_TPU_DISABLE_PALLAS", "1")
     q_ref, s_ref = qz.quantize_int8(x)
-    qb_ref, sb_ref = qz.quantize_int8(x, block_size=128)
+    qb_ref, sb_ref = qz.quantize_int8(xb, block_size=128)
 
     monkeypatch.delenv("PS_TPU_DISABLE_PALLAS", raising=False)
     monkeypatch.setenv("PS_TPU_PALLAS_INTERPRET", "1")
     q_pl, s_pl = qz.quantize_int8(x)
-    qb_pl, sb_pl = qz.quantize_int8(x, block_size=128)
+    qb_pl, sb_pl = qz.quantize_int8(xb, block_size=128)
 
     np.testing.assert_array_equal(np.asarray(q_ref), np.asarray(q_pl))
     np.testing.assert_allclose(np.asarray(s_ref), np.asarray(s_pl))

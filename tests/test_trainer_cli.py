@@ -200,3 +200,27 @@ def test_cli_tune_main(tmp_path, monkeypatch):
     )
     assert set(out) == {0.01, 0.5}
     assert all(np.isfinite(v) for v in out.values())
+
+
+def test_bf16_training_path(tmp_path, tiny_ds):
+    tcfg = _tcfg(tmp_path, max_steps=3, dtype="bfloat16", save_checkpoints=False)
+    tr = Trainer(tcfg, PSConfig(num_workers=2), dataset=tiny_ds)
+    metrics = tr.train()
+    assert np.isfinite(metrics["loss"])
+    # params remain f32 (mixed precision: bf16 is the compute dtype only)
+    leaf = jax.tree_util.tree_leaves(jax.device_get(tr.state.params))[0]
+    assert leaf.dtype == np.float32
+
+
+def test_profile_dir_writes_trace(tmp_path, tiny_ds):
+    import os
+
+    tcfg = _tcfg(
+        tmp_path, max_steps=4, save_checkpoints=False,
+        profile_dir=str(tmp_path / "trace"),
+    )
+    Trainer(tcfg, PSConfig(num_workers=2), dataset=tiny_ds).train()
+    found = []
+    for root, _, files in os.walk(tmp_path / "trace"):
+        found += files
+    assert found, "profiler produced no trace files"
