@@ -1,0 +1,58 @@
+// psnative loader core — threaded batch assembly for the host data path.
+//
+// Role parity with the reference's vendored multiprocessing DataLoader
+// (reference: src/data_loader_ops/my_data_loader.py — worker pool, index
+// queue, collate). On this framework the per-batch transform work runs
+// on-device (data/augment.py), so the host's remaining job is the index
+// gather: scatter-free strided copies of the selected samples into one
+// contiguous batch buffer. That is a memory-bandwidth problem, so the
+// native core is a thread-parallel memcpy loop, not a process pool.
+//
+// Bounds are enforced per index; out-of-range indices abort the fill and
+// return 0 so the Python side can raise instead of reading garbage.
+
+#include <cstdint>
+#include <cstring>
+#include <thread>
+#include <vector>
+
+extern "C" {
+
+// Gather rows: dst[i] = src[indices[i]] for i in [0, n_idx), where each row
+// is item_bytes wide and src holds n_src rows. Returns 1 on success, 0 if
+// any index is out of range. n_threads <= 0 = hardware concurrency.
+int psl_gather(const uint8_t* src, int64_t n_src, int64_t item_bytes,
+               const int64_t* indices, int64_t n_idx, uint8_t* dst,
+               int n_threads) {
+  for (int64_t i = 0; i < n_idx; ++i)
+    if (indices[i] < 0 || indices[i] >= n_src) return 0;
+
+  unsigned hw = std::thread::hardware_concurrency();
+  int64_t want = n_threads > 0 ? n_threads : (hw ? int64_t(hw) : 1);
+  int64_t threads = std::min<int64_t>(want, n_idx > 0 ? n_idx : 1);
+  // thread spawn costs ~100us each; below a few MB a single memcpy loop
+  // wins (typical label gathers are a few hundred bytes)
+  if (n_threads <= 0 && n_idx * item_bytes < (int64_t(4) << 20)) threads = 1;
+  if (threads <= 1) {
+    for (int64_t i = 0; i < n_idx; ++i)
+      std::memcpy(dst + i * item_bytes, src + indices[i] * item_bytes,
+                  size_t(item_bytes));
+    return 1;
+  }
+  std::vector<std::thread> pool;
+  int64_t chunk = (n_idx + threads - 1) / threads;
+  for (int64_t t = 0; t < threads; ++t) {
+    int64_t lo = t * chunk;
+    int64_t hi = std::min(n_idx, lo + chunk);
+    if (lo >= hi) break;
+    pool.emplace_back([=]() {
+      for (int64_t i = lo; i < hi; ++i)
+        std::memcpy(dst + i * item_bytes, src + indices[i] * item_bytes,
+                    size_t(item_bytes));
+    });
+  }
+  for (auto& th : pool) th.join();
+  return 1;
+}
+
+}  // extern "C"

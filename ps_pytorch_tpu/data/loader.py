@@ -18,10 +18,47 @@ reproduces exactly that (seeded per-worker shuffles of the full set) while
 from __future__ import annotations
 
 import collections
+import ctypes
 from typing import Iterator, Optional
 
 import jax
 import numpy as np
+
+
+def gather_rows(array: np.ndarray, indices: np.ndarray) -> np.ndarray:
+    """Batch assembly: array[indices] through the native threaded gather
+    core (native/loader.cc — the reference's DataLoader worker pool reduced
+    to its actual job, a parallel strided copy), with a numpy fallback.
+
+    Index semantics are identical on both paths: out-of-range (including
+    negative — no numpy wrapping) raises IndexError."""
+    from ..ops.codec import _load
+
+    idx = np.ascontiguousarray(indices, np.int64)
+    if idx.size and (idx.min() < 0 or idx.max() >= len(array)):
+        raise IndexError("gather index out of range")
+    lib = _load()
+    if (
+        lib is None
+        or getattr(lib, "psl_gather", None) is None
+        or array.nbytes == 0
+        or not array.flags.c_contiguous
+    ):
+        return array[idx]
+    item_bytes = array.dtype.itemsize * int(np.prod(array.shape[1:], dtype=np.int64))
+    out = np.empty((len(idx),) + array.shape[1:], array.dtype)
+    ok = lib.psl_gather(
+        array.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        array.shape[0],
+        item_bytes,
+        idx.ctypes.data_as(ctypes.POINTER(ctypes.c_int64)),
+        len(idx),
+        out.ctypes.data_as(ctypes.POINTER(ctypes.c_uint8)),
+        0,
+    )
+    if not ok:
+        raise IndexError("gather index out of range")
+    return out
 
 
 class BatchIterator:
@@ -45,7 +82,10 @@ class BatchIterator:
             reps = -(-batch_size // len(images))
             images = np.concatenate([images] * reps)
             labels = np.concatenate([labels] * reps)
-        self.images, self.labels = images, labels
+        # contiguous once up front: the native gather needs C layout, and
+        # doing it per batch would copy the whole dataset every iteration
+        self.images = np.ascontiguousarray(images)
+        self.labels = np.ascontiguousarray(labels)
         self.batch_size = batch_size
         self.shuffle = shuffle
         self.drop_last = drop_last
@@ -72,8 +112,8 @@ class BatchIterator:
             if len(batch_idx) < self.batch_size and self.drop_last:
                 return
             yield {
-                "image": self.images[batch_idx],
-                "label": self.labels[batch_idx],
+                "image": gather_rows(self.images, batch_idx),
+                "label": gather_rows(self.labels, batch_idx),
             }
 
     def __iter__(self):

@@ -27,7 +27,8 @@ import numpy as np
 _PKG_DIR = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 _NATIVE_DIR = os.path.join(_PKG_DIR, "_native")
 _LIB_PATH = os.path.join(_NATIVE_DIR, "libpsnative.so")
-_SRC_PATH = os.path.join(os.path.dirname(_PKG_DIR), "native", "codec.cc")
+_SRC_DIR = os.path.join(os.path.dirname(_PKG_DIR), "native")
+_SOURCES = ("codec.cc", "loader.cc")
 
 _lock = threading.Lock()
 _lib = None
@@ -36,21 +37,27 @@ _lib_tried = False
 MAGIC = b"PSAR"  # array framing magic (codec stream has its own 'PSC1')
 
 
-def _build_library() -> Optional[str]:
-    if not os.path.exists(_SRC_PATH):
+def _build_library() -> Optional[ctypes.CDLL]:
+    """Compile the native sources and return a handle to the FRESH build.
+
+    The handle is dlopen'd from a unique temp path before the os.replace
+    into _LIB_PATH: dlopen caches by pathname, so re-opening _LIB_PATH
+    after replacing a stale .so would silently return the old mapping."""
+    sources = [os.path.join(_SRC_DIR, s) for s in _SOURCES]
+    if not all(os.path.exists(s) for s in sources):
         return None
     os.makedirs(_NATIVE_DIR, exist_ok=True)
-    # compile to a private temp path and os.replace into place, so a
-    # concurrent process can never CDLL a half-written .so
     tmp = f"{_LIB_PATH}.{os.getpid()}.tmp"
     cmd = [
         os.environ.get("CXX", "g++"),
         "-O3", "-std=c++17", "-fPIC", "-Wall",
         "-shared", "-pthread",
-        "-o", tmp, _SRC_PATH,
+        "-o", tmp, *sources,
     ]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=300)
+        lib = ctypes.CDLL(tmp)
+        # publish for other processes; our mapping survives the rename
         os.replace(tmp, _LIB_PATH)
     except (OSError, subprocess.SubprocessError):
         try:
@@ -58,7 +65,7 @@ def _build_library() -> Optional[str]:
         except OSError:
             pass
         return None
-    return _LIB_PATH
+    return lib
 
 
 def _load() -> Optional[ctypes.CDLL]:
@@ -68,12 +75,19 @@ def _load() -> Optional[ctypes.CDLL]:
         if _lib_tried:
             return _lib
         _lib_tried = True
-        path = _LIB_PATH if os.path.exists(_LIB_PATH) else _build_library()
-        if path is None:
-            return None
-        try:
-            lib = ctypes.CDLL(path)
-        except OSError:
+        lib = None
+        if os.path.exists(_LIB_PATH):
+            try:
+                lib = ctypes.CDLL(_LIB_PATH)
+            except OSError:
+                lib = None
+        if lib is None or getattr(lib, "psl_gather", None) is None:
+            # missing or stale (pre-loader.cc) build — compile fresh; keep
+            # a stale-but-working codec lib if no compiler is available
+            rebuilt = _build_library()
+            if rebuilt is not None:
+                lib = rebuilt
+        if lib is None:
             return None
         u8p = ctypes.POINTER(ctypes.c_uint8)
         lib.psc_max_compressed.restype = ctypes.c_size_t
@@ -88,6 +102,13 @@ def _load() -> Optional[ctypes.CDLL]:
         lib.psc_decompress.argtypes = [
             u8p, ctypes.c_size_t, u8p, ctypes.c_size_t, ctypes.c_int,
         ]
+        if getattr(lib, "psl_gather", None) is not None:
+            lib.psl_gather.restype = ctypes.c_int
+            lib.psl_gather.argtypes = [
+                u8p, ctypes.c_int64, ctypes.c_int64,
+                ctypes.POINTER(ctypes.c_int64), ctypes.c_int64, u8p,
+                ctypes.c_int,
+            ]
         _lib = lib
         return _lib
 

@@ -119,3 +119,25 @@ def test_prefetch_to_device():
     out = list(prefetch_to_device(it.epoch()))
     assert len(out) == 4
     assert isinstance(out[0]["image"], jax.Array)
+
+
+def test_native_gather_matches_numpy():
+    from ps_pytorch_tpu.data.loader import gather_rows
+
+    rng = np.random.RandomState(0)
+    arr = rng.randint(0, 255, (100, 7, 7, 3)).astype(np.uint8)
+    idx = rng.permutation(100)[:32]
+    np.testing.assert_array_equal(gather_rows(arr, idx), arr[idx])
+    lbl = rng.randint(0, 10, 100).astype(np.int32)
+    np.testing.assert_array_equal(gather_rows(lbl, idx), lbl[idx])
+
+
+def test_native_gather_rejects_bad_index():
+    # identical semantics on native and numpy paths: no wrapping, IndexError
+    from ps_pytorch_tpu.data.loader import gather_rows
+
+    arr = np.zeros((10, 4), np.float32)
+    with pytest.raises(IndexError):
+        gather_rows(arr, np.array([0, 10]))
+    with pytest.raises(IndexError):
+        gather_rows(arr, np.array([-1]))
