@@ -42,8 +42,13 @@ def checkpoint_path(model_dir: str, step: int) -> str:
 
 def save_checkpoint(state, model_dir: str, step: int, compress: bool = False) -> str:
     """Atomically write `state` (any flax-serializable pytree) for `step`."""
+    return _write_host_state(jax.device_get(state), model_dir, step, compress)
+
+
+def _write_host_state(state, model_dir: str, step: int, compress: bool) -> str:
+    """Host-side half of a save (state already device_get). Runs on the
+    async writer thread; everything here is pure host CPU + disk."""
     os.makedirs(model_dir, exist_ok=True)
-    state = jax.device_get(state)
     path = checkpoint_path(model_dir, step)
     data = serialization.to_bytes(state)
     if compress:
@@ -57,6 +62,37 @@ def save_checkpoint(state, model_dir: str, step: int, compress: bool = False) ->
         f.write(data)
     os.replace(tmp, path)
     return path
+
+
+class AsyncCheckpointer:
+    """Overlap checkpoint serialization + disk IO with training.
+
+    The device->host transfer happens on the caller's thread (it must
+    observe a consistent step boundary); msgpack serialization, codec
+    compression, and the atomic write run on one background thread, so the
+    train loop never blocks on disk. `wait()` drains pending writes —
+    Trainer.train calls it before returning, keeping the reference's
+    synchronous visible behavior (a checkpoint exists when training is
+    done) without its per-step stall. Single writer by construction
+    (one thread), preserving the no-torn-reads guarantee."""
+
+    def __init__(self):
+        from concurrent.futures import ThreadPoolExecutor
+
+        self._pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="ckpt")
+        self._pending = None
+
+    def save(self, state, model_dir: str, step: int, compress: bool = False):
+        host_state = jax.device_get(state)
+        self.wait()  # keep at most one write in flight
+        self._pending = self._pool.submit(
+            _write_host_state, host_state, model_dir, step, compress
+        )
+
+    def wait(self):
+        if self._pending is not None:
+            self._pending.result()
+            self._pending = None
 
 
 def _read_bytes(model_dir: str, step: int) -> bytes:

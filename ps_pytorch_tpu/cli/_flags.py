@@ -57,7 +57,8 @@ def add_train_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="compute dtype (bfloat16 = MXU-native; params stay f32)")
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="write a jax.profiler trace of ~10 steps here")
-    # accepted-for-parity flags (see module docstring)
+    # parity flags: --mode != normal arms the straggler watchdog with
+    # --kill-threshold seconds (detection/warning; nothing to kill in SPMD)
     parser.add_argument("--mode", type=str, default="normal")
     parser.add_argument("--kill-threshold", type=float, default=7.0)
     parser.add_argument("--comm-type", type=str, default="Bcast")
@@ -117,6 +118,9 @@ def train_config_from(args: argparse.Namespace) -> TrainConfig:
         shard_mode=args.shard_mode,
         dtype=args.dtype,
         profile_dir=args.profile_dir,
+        straggler_threshold_s=(
+            args.kill_threshold if args.mode != "normal" else None
+        ),
     )
 
 

@@ -89,6 +89,10 @@ class TrainConfig:
     shard_mode: str = "reshuffle"  # reference parity; "disjoint" improvement
     dtype: str = "float32"  # compute dtype: float32 | bfloat16 (MXU-native)
     profile_dir: Optional[str] = None  # jax.profiler trace output (eval_freq window)
+    # straggler watchdog (reference --kill-threshold, distributed_nn.py:52:
+    # there it was meant to kill slow workers; under SPMD there is nothing
+    # to kill, so the live semantics are detection + structured warning)
+    straggler_threshold_s: Optional[float] = None
 
 
 class Trainer:
@@ -131,6 +135,7 @@ class Trainer:
             self.model, pcfg, self.mesh, preprocess=pre_eval
         )
         self._key = jax.random.key(tcfg.seed + 1)
+        self._ckpt = ckpt.AsyncCheckpointer()
         logger.info(
             "model %s (%d params), dataset %s%s, %d workers",
             tcfg.network,
@@ -176,6 +181,7 @@ class Trainer:
         steps_per_epoch = len(iters[0])
         metrics = {}
         step_no = int(jax.device_get(self.state.step))
+        first_step = step_no + 1  # pays XLA compilation (also after resume)
         timer = PhaseTimer()
         done = False
         # profiler window: ~10 post-compile steps, parity role of the
@@ -192,71 +198,88 @@ class Trainer:
         profile_stop = profile_start + 10 if t.profile_dir else None
         profiling = False
         last_saved = None
-        for epoch in range(1, t.epochs + 1):
-            if done:
-                break
-            epochs_iters = [it.epoch() for it in iters]
-            for batch_idx in range(steps_per_epoch):
-                if step_no >= t.max_steps:
-                    # check BEFORE stepping so a --resume of a finished run
-                    # is a no-op instead of overshooting max_steps
-                    done = True
+        try:
+            for epoch in range(1, t.epochs + 1):
+                if done:
                     break
-                if profile_start is not None and step_no + 1 == profile_start:
-                    jax.profiler.start_trace(t.profile_dir)
-                    profiling = True
-                elif profiling and step_no + 1 == profile_stop:
-                    jax.block_until_ready(self.state.params)
-                    jax.profiler.stop_trace()
-                    profiling = False
-                timer.reset()
-                with timer.phase("fetch"):
-                    parts = [next(ei) for ei in epochs_iters]
-                    batch = {
-                        k: np.concatenate([p[k] for p in parts]) for k in parts[0]
-                    }
-                    sharded = shard_batch(batch, self.mesh, self.pcfg)
-                with timer.phase("step"):
-                    self.state, metrics = self._train_step(
-                        self.state, sharded, self._key
-                    )
-                    metrics = jax.device_get(metrics)
-                step_no += 1
-                if step_no % t.log_interval == 0 or step_no == 1:
-                    logger.info(
-                        format_iter_line(
-                            rank="mesh",
-                            step=step_no,
-                            epoch=epoch,
-                            seen=batch_idx * global_batch,
-                            total=total * self.pcfg.num_workers,
-                            loss=float(metrics["loss"]),
-                            time_cost=timer.total,
-                            fetch=timer.durations.get("fetch", 0.0),
-                            forward=timer.durations.get("step", 0.0),
+                epochs_iters = [it.epoch() for it in iters]
+                for batch_idx in range(steps_per_epoch):
+                    if step_no >= t.max_steps:
+                        # check BEFORE stepping so a --resume of a finished run
+                        # is a no-op instead of overshooting max_steps
+                        done = True
+                        break
+                    if profile_start is not None and step_no + 1 == profile_start:
+                        jax.profiler.start_trace(t.profile_dir)
+                        profiling = True
+                    elif profiling and step_no + 1 == profile_stop:
+                        jax.block_until_ready(self.state.params)
+                        jax.profiler.stop_trace()
+                        profiling = False
+                    timer.reset()
+                    with timer.phase("fetch"):
+                        parts = [next(ei) for ei in epochs_iters]
+                        batch = {
+                            k: np.concatenate([p[k] for p in parts]) for k in parts[0]
+                        }
+                        sharded = shard_batch(batch, self.mesh, self.pcfg)
+                    with timer.phase("step"):
+                        self.state, metrics = self._train_step(
+                            self.state, sharded, self._key
                         )
-                    )
-                if t.save_checkpoints and step_no % t.eval_freq == 0:
-                    ckpt.save_checkpoint(
-                        jax.device_get(self.state),
-                        t.train_dir,
-                        step_no,
-                        compress=t.compress_checkpoints,
-                    )
-                    last_saved = step_no
-                if step_no >= t.max_steps:
-                    done = True
-                    break
-        if profiling:  # run ended inside the window
-            jax.block_until_ready(self.state.params)
-            jax.profiler.stop_trace()
-        if t.save_checkpoints and metrics and last_saved != step_no:
-            ckpt.save_checkpoint(
-                jax.device_get(self.state),
-                t.train_dir,
-                step_no,
-                compress=t.compress_checkpoints,
-            )
+                        metrics = jax.device_get(metrics)
+                    step_no += 1
+                    if (
+                        t.straggler_threshold_s is not None
+                        and timer.total > t.straggler_threshold_s
+                        and step_no != first_step  # compilation step exempt
+                    ):
+                        logger.warning(
+                            "straggler step: Step: %d took %.4fs (threshold %.4fs)",
+                            step_no,
+                            timer.total,
+                            t.straggler_threshold_s,
+                        )
+                    if step_no % t.log_interval == 0 or step_no == 1:
+                        logger.info(
+                            format_iter_line(
+                                rank="mesh",
+                                step=step_no,
+                                epoch=epoch,
+                                seen=batch_idx * global_batch,
+                                total=total * self.pcfg.num_workers,
+                                loss=float(metrics["loss"]),
+                                time_cost=timer.total,
+                                fetch=timer.durations.get("fetch", 0.0),
+                                forward=timer.durations.get("step", 0.0),
+                            )
+                        )
+                    if t.save_checkpoints and step_no % t.eval_freq == 0:
+                        self._ckpt.save(
+                            self.state,
+                            t.train_dir,
+                            step_no,
+                            compress=t.compress_checkpoints,
+                        )
+                        last_saved = step_no
+                    if step_no >= t.max_steps:
+                        done = True
+                        break
+            if profiling:  # run ended inside the window
+                jax.block_until_ready(self.state.params)
+                jax.profiler.stop_trace()
+            if t.save_checkpoints and metrics and last_saved != step_no:
+                self._ckpt.save(
+                    self.state,
+                    t.train_dir,
+                    step_no,
+                    compress=t.compress_checkpoints,
+                )
+        finally:
+            # drain the async writer even on error, so a submitted
+            # checkpoint is durable (or its failure raised) before the
+            # caller observes the outcome
+            self._ckpt.wait()
         return {k: float(v) for k, v in metrics.items()}
 
     # ---------------------------------------------------------------- validate

@@ -224,3 +224,28 @@ def test_profile_dir_writes_trace(tmp_path, tiny_ds):
     for root, _, files in os.walk(tmp_path / "trace"):
         found += files
     assert found, "profiler produced no trace files"
+
+
+def test_straggler_watchdog_warns(tmp_path, tiny_ds, caplog):
+    import logging
+
+    tcfg = _tcfg(
+        tmp_path, max_steps=3, save_checkpoints=False,
+        straggler_threshold_s=0.0,  # every post-compile step "straggles"
+    )
+    # the package logger has propagate=False, so attach the capture handler
+    lg = logging.getLogger("ps_pytorch_tpu")
+    lg.addHandler(caplog.handler)
+    try:
+        Trainer(tcfg, PSConfig(num_workers=2), dataset=tiny_ds).train()
+    finally:
+        lg.removeHandler(caplog.handler)
+    warnings = [r for r in caplog.records if "straggler step" in r.getMessage()]
+    assert len(warnings) == 2  # steps 2 and 3 (step 1 pays compilation)
+
+
+def test_async_checkpointer_visible_after_train(tmp_path, tiny_ds):
+    # train() must not return before the last checkpoint is durable
+    tcfg = _tcfg(tmp_path, max_steps=5, eval_freq=2)
+    Trainer(tcfg, PSConfig(num_workers=2), dataset=tiny_ds).train()
+    assert ckpt.available_steps(tcfg.train_dir) == [2, 4, 5]
