@@ -27,6 +27,7 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
@@ -54,6 +55,20 @@ def _block_attend(q, k, v, mask, scale):
     return m_blk, p_sum, pv
 
 
+def _accumulate(acc, m_blk, p_sum, pv):
+    """Fold one block's (max, sum, numerator) into the running triple."""
+    o, m, l = acc
+    m_new = jnp.maximum(m, m_blk)
+    alpha = jnp.exp(m - m_new)  # rescale old accumulators
+    beta = jnp.exp(m_blk - m_new)  # rescale this block
+    l_new = l * alpha + p_sum * beta
+    o_new = (
+        o * alpha.transpose(0, 2, 1)[..., None]
+        + pv * beta.transpose(0, 2, 1)[..., None]
+    )
+    return o_new, m_new, l_new
+
+
 def ring_attention(
     q: jax.Array,
     k: jax.Array,
@@ -61,11 +76,17 @@ def ring_attention(
     axis_name: str = SEQ_AXIS,
     causal: bool = False,
     scale: Optional[float] = None,
+    bidirectional: bool = False,
 ) -> jax.Array:
     """Exact attention over sequence shards rotating on a ring.
 
     Call inside shard_map with q/k/v sharded [B, T_local, H, D] along the
     sequence axis `axis_name`. Returns the local output shard.
+
+    `bidirectional=True` rotates K/V both ways simultaneously and processes
+    two blocks per hop: same total traffic, half the sequential hops, and
+    both ICI directions of a physical ring in use. Falls back to the
+    one-way ring for n <= 2 (nothing to overlap).
     """
     n = lax.axis_size(axis_name)
     me = lax.axis_index(axis_name)
@@ -73,41 +94,78 @@ def ring_attention(
     if scale is None:
         scale = 1.0 / (d ** 0.5)
 
-    # send my k/v block to the PREVIOUS device each hop: after s hops,
-    # device i holds key block (i + s) mod n
-    perm = [(j, (j - 1) % n) for j in range(n)]
-
     q_pos = me * t_loc + jnp.arange(t_loc)  # global query positions
 
-    def hop(carry, s):
-        o, m, l, k_cur, v_cur = carry
-        k_blk = (me + s) % n
-        if causal:
-            k_pos = k_blk * t_loc + jnp.arange(t_loc)
-            mask = k_pos[None, :] <= q_pos[:, None]  # [Tq, Tk]
-        else:
-            mask = None
-        m_blk, p_sum, pv = _block_attend(q, k_cur, v_cur, mask, scale)
-        m_new = jnp.maximum(m, m_blk)
-        alpha = jnp.exp(m - m_new)  # rescale old accumulators
-        beta = jnp.exp(m_blk - m_new)  # rescale this block
-        l_new = l * alpha + p_sum * beta
-        o_new = (
-            o * alpha.transpose(0, 2, 1)[..., None]
-            + pv * beta.transpose(0, 2, 1)[..., None]
-        )
-        # uniform rotation every hop keeps the loop body identical for XLA
-        # (the final hop's permute returns k/v to their home devices)
-        k_nxt = lax.ppermute(k_cur, axis_name, perm)
-        v_nxt = lax.ppermute(v_cur, axis_name, perm)
-        return (o_new, m_new, l_new, k_nxt, v_nxt), None
+    def block_mask(k_blk):
+        if not causal:
+            return None
+        k_pos = k_blk * t_loc + jnp.arange(t_loc)
+        return k_pos[None, :] <= q_pos[:, None]  # [Tq, Tk]
 
     o0 = jnp.zeros_like(q)
     m0 = jnp.full((b, h, t_loc), _NEG_BIG, q.dtype)
     l0 = jnp.zeros((b, h, t_loc), q.dtype)
-    # scan (not fori_loop): reverse-mode AD must flow through the ring for
-    # training; ppermute transposes to the inverse rotation in the backward
-    (o, m, l, _, _), _ = lax.scan(hop, (o0, m0, l0, k, v), jnp.arange(n))
+
+    # send my k/v block to the PREVIOUS device each hop: after s hops,
+    # device i holds key block (i + s) mod n
+    perm_fwd = [(j, (j - 1) % n) for j in range(n)]
+
+    if not bidirectional or n <= 2:
+
+        def hop(carry, s):
+            o, m, l, k_cur, v_cur = carry
+            m_blk, p_sum, pv = _block_attend(
+                q, k_cur, v_cur, block_mask((me + s) % n), scale
+            )
+            acc = _accumulate((o, m, l), m_blk, p_sum, pv)
+            # uniform rotation every hop keeps the loop body identical for
+            # XLA (the final hop's permute returns k/v home)
+            k_nxt = lax.ppermute(k_cur, axis_name, perm_fwd)
+            v_nxt = lax.ppermute(v_cur, axis_name, perm_fwd)
+            return (*acc, k_nxt, v_nxt), None
+
+        # scan (not fori_loop): reverse-mode AD must flow through the ring
+        # for training; ppermute transposes to the inverse rotation
+        (o, m, l, _, _), _ = lax.scan(hop, (o0, m0, l0, k, v), jnp.arange(n))
+    else:
+        perm_bwd = [(j, (j + 1) % n) for j in range(n)]
+        # own block first (no comm), then ceil((n-1)/2) two-block hops
+        acc = _accumulate(
+            (o0, m0, l0), *_block_attend(q, k, v, block_mask(me), scale)
+        )
+        n_hops = (n - 1 + 1) // 2
+        # offsets +s (fwd) and -s (bwd) cover 1..n-1; for even n the offset
+        # n/2 arrives on both streams — drop the bwd duplicate at s = n/2
+        use_bwd = np.ones(n_hops, bool)
+        if n % 2 == 0:
+            use_bwd[-1] = False
+
+        def hop2(carry, xs):
+            s, bwd_ok = xs
+            o, m, l, k_f, v_f, k_b, v_b = carry
+            k_f = lax.ppermute(k_f, axis_name, perm_fwd)
+            v_f = lax.ppermute(v_f, axis_name, perm_fwd)
+            k_b = lax.ppermute(k_b, axis_name, perm_bwd)
+            v_b = lax.ppermute(v_b, axis_name, perm_bwd)
+            acc = _accumulate(
+                (o, m, l),
+                *_block_attend(q, k_f, v_f, block_mask((me + s) % n), scale),
+            )
+            m_blk, p_sum, pv = _block_attend(
+                q, k_b, v_b, block_mask((me - s) % n), scale
+            )
+            # mask the duplicate block to a no-op contribution
+            m_blk = jnp.where(bwd_ok, m_blk, _NEG_BIG)
+            p_sum = jnp.where(bwd_ok, p_sum, 0.0)
+            pv = jnp.where(bwd_ok, pv, 0.0)
+            acc = _accumulate(acc, m_blk, p_sum, pv)
+            return (*acc, k_f, v_f, k_b, v_b), None
+
+        (o, m, l, *_), _ = lax.scan(
+            hop2,
+            (*acc, k, v, k, v),
+            (jnp.arange(1, n_hops + 1), jnp.asarray(use_bwd)),
+        )
     # causal guarantees >= 1 valid key per query (its own position), so l > 0
     return o / l.transpose(0, 2, 1)[..., None]
 
@@ -137,12 +195,20 @@ def make_seq_mesh(num_shards: Optional[int] = None) -> Mesh:
 
 
 def make_ring_attention(
-    mesh: Mesh, axis_name: str = SEQ_AXIS, causal: bool = False
+    mesh: Mesh,
+    axis_name: str = SEQ_AXIS,
+    causal: bool = False,
+    bidirectional: bool = False,
 ):
     """Jitted sequence-sharded attention: (q, k, v) [B, T, H, D] global ->
     [B, T, H, D] global, T sharded over the mesh axis."""
     mapped = jax.shard_map(
-        partial(ring_attention, axis_name=axis_name, causal=causal),
+        partial(
+            ring_attention,
+            axis_name=axis_name,
+            causal=causal,
+            bidirectional=bidirectional,
+        ),
         mesh=mesh,
         in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
         out_specs=P(None, axis_name),

@@ -265,9 +265,6 @@ class Trainer:
                     if step_no >= t.max_steps:
                         done = True
                         break
-            if profiling:  # run ended inside the window
-                jax.block_until_ready(self.state.params)
-                jax.profiler.stop_trace()
             if t.save_checkpoints and metrics and last_saved != step_no:
                 self._ckpt.save(
                     self.state,
@@ -276,6 +273,9 @@ class Trainer:
                     compress=t.compress_checkpoints,
                 )
         finally:
+            if profiling:  # run ended (or raised) inside the window
+                jax.block_until_ready(self.state.params)
+                jax.profiler.stop_trace()
             # drain the async writer even on error, so a submitted
             # checkpoint is durable (or its failure raised) before the
             # caller observes the outcome

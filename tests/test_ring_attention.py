@@ -129,3 +129,109 @@ def test_sp_transformer_trains(seq_mesh):
     params2 = jax.tree_util.tree_map(lambda p, g: p - 0.5 * g, params, grads)
     l1 = loss_fn(params2, tok_sharded)
     assert np.isfinite(float(l0)) and float(l1) < float(l0)
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["bidir-mask-off", "causal"])
+def test_bidirectional_ring_matches_full(seq_mesh, causal):
+    # even n=8: exercises the duplicate-offset (n/2) masking
+    q, k, v = _qkv(seed=5)
+    ring = make_ring_attention(seq_mesh, causal=causal, bidirectional=True)
+    got = ring(
+        shard_sequence(q, seq_mesh),
+        shard_sequence(k, seq_mesh),
+        shard_sequence(v, seq_mesh),
+    )
+    want = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        jax.device_get(got), jax.device_get(want), rtol=2e-5, atol=2e-5
+    )
+
+
+def test_bidirectional_ring_gradients(seq_mesh):
+    q, k, v = _qkv(seed=6)
+
+    def ring_loss(q, k, v):
+        out = jax.shard_map(
+            lambda a, b, c: ring_attention(
+                a, b, c, SEQ_AXIS, causal=True, bidirectional=True
+            ),
+            mesh=seq_mesh,
+            in_specs=(P(None, SEQ_AXIS),) * 3,
+            out_specs=P(None, SEQ_AXIS),
+            check_vma=False,
+        )(q, k, v)
+        return jnp.sum(out * jnp.cos(out))
+
+    def full_loss(q, k, v):
+        out = full_attention(q, k, v, causal=True)
+        return jnp.sum(out * jnp.cos(out))
+
+    got = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    want = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            jax.device_get(g), jax.device_get(w), rtol=5e-4, atol=5e-5
+        )
+
+
+def test_bidirectional_odd_ring_matches_full():
+    # odd n: no duplicate offset; 7-device mesh from the 8 available
+    from ps_pytorch_tpu.parallel.ring_attention import make_seq_mesh
+
+    mesh7 = make_seq_mesh(7)
+    rng = np.random.RandomState(9)
+    mk = lambda: jnp.asarray(rng.randn(2, 56, 4, 16).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    ring = make_ring_attention(mesh7, causal=True, bidirectional=True)
+    got = ring(
+        shard_sequence(q, mesh7), shard_sequence(k, mesh7), shard_sequence(v, mesh7)
+    )
+    np.testing.assert_allclose(
+        jax.device_get(got),
+        jax.device_get(full_attention(q, k, v, causal=True)),
+        rtol=2e-5,
+        atol=2e-5,
+    )
+
+
+def test_remat_transformer_matches_and_trains(seq_mesh):
+    # remat must not change values or gradients, only the backward schedule —
+    # including composed with ring attention under shard_map (remat re-runs
+    # the block's ppermute collectives in the rematerialized backward, the
+    # interaction most at risk across JAX upgrades)
+    mk = lambda **kw: TransformerConfig(
+        vocab_size=32, dim=32, depth=2, heads=2, max_seq_len=T, **kw
+    )
+    params = init_transformer(mk(), jax.random.key(2))
+    rng = np.random.RandomState(8)
+    tokens = jnp.asarray(rng.randint(0, 32, (B, T)), jnp.int32)
+
+    def single_loss(c):
+        def f(p):
+            logits = apply_transformer(c, p, tokens)
+            return jnp.mean(logits ** 2)
+        return f
+
+    l0, g0 = jax.value_and_grad(single_loss(mk()))(params)
+    l1, g1 = jax.value_and_grad(single_loss(mk(remat=True)))(params)
+    np.testing.assert_allclose(float(l0), float(l1), rtol=1e-6)
+    for a, b in zip(jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g1)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=1e-5, atol=1e-6)
+
+    # sp path: remat (+bidirectional ring) through shard_map
+    def sp_loss(c):
+        fwd = make_sp_forward(c, seq_mesh, jit=False)
+
+        @jax.jit
+        def f(p, tok):
+            return jnp.mean(fwd(p, tok) ** 2)
+
+        return f
+
+    tok_sharded = shard_sequence(tokens, seq_mesh)
+    l2, g2 = jax.value_and_grad(
+        sp_loss(mk(remat=True, bidirectional_ring=True))
+    )(params, tok_sharded)
+    np.testing.assert_allclose(float(l0), float(l2), rtol=1e-5)
+    for a, b in zip(jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g2)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5)
