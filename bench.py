@@ -41,8 +41,23 @@ WORKLOADS = {
 }
 
 
+def _enable_persistent_compile_cache(jax) -> None:
+    """First compile of the big step is ~20-40s on TPU; cache it on disk so
+    repeated bench/driver runs skip straight to steady state."""
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/ps_tpu_jax_cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax without these options
+
+
 def main() -> None:
     import jax
+
+    _enable_persistent_compile_cache(jax)
 
     from ps_pytorch_tpu.data import IMAGE_SHAPES, make_preprocessor, make_synthetic
     from ps_pytorch_tpu.models import build_model

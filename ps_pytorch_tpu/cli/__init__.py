@@ -4,6 +4,9 @@
 - ``python -m ps_pytorch_tpu.cli.single_machine`` <- src/single_machine.py
 - ``python -m ps_pytorch_tpu.cli.evaluate``       <- src/distributed_evaluator.py
 - ``python -m ps_pytorch_tpu.cli.tune``           <- src/tune.sh + tiny_tuning_parser.py
+- ``python -m ps_pytorch_tpu.cli.prepare_data``   <- src/data/data_prepare.py
+- ``python -m ps_pytorch_tpu.cli.train_lm``       (no reference counterpart:
+  long-context LM over a 2-D data x sequence mesh with ring attention)
 
 One process drives the whole mesh (no mpirun); `--num-workers` replaces the
 hostfile/world-size, and multi-host pods join via --coordinator-address

@@ -57,6 +57,10 @@ def add_train_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="compute dtype (bfloat16 = MXU-native; params stay f32)")
     parser.add_argument("--profile-dir", type=str, default=None,
                         help="write a jax.profiler trace of ~10 steps here")
+    parser.add_argument("--remat", action="store_true",
+                        help="rematerialize ResNet blocks in backward (saves memory)")
+    parser.add_argument("--metrics-file", type=str, default=None,
+                        help="append machine-readable metrics (one JSON/line)")
     # parity flags: --mode != normal arms the straggler watchdog with
     # --kill-threshold seconds (detection/warning; nothing to kill in SPMD)
     parser.add_argument("--mode", type=str, default="normal")
@@ -118,6 +122,8 @@ def train_config_from(args: argparse.Namespace) -> TrainConfig:
         shard_mode=args.shard_mode,
         dtype=args.dtype,
         profile_dir=args.profile_dir,
+        remat=args.remat,
+        metrics_file=args.metrics_file,
         straggler_threshold_s=(
             args.kill_threshold if args.mode != "normal" else None
         ),

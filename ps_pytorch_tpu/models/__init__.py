@@ -49,8 +49,11 @@ def build_model(
     num_classes: int = 10,
     dtype: Any = jnp.float32,
     bn_axis_name: Optional[str] = None,
+    remat: bool = False,
 ):
-    """Construct a model by CLI name (parity: util.py:8-19)."""
+    """Construct a model by CLI name (parity: util.py:8-19). `remat`
+    enables per-block activation rematerialization (ResNet family only —
+    LeNet/VGG are too shallow for it to matter)."""
     if model_name not in MODEL_REGISTRY:
         raise ValueError(
             f"unknown model {model_name!r}; choose from {sorted(MODEL_REGISTRY)}"
@@ -59,6 +62,10 @@ def build_model(
     kwargs = dict(num_classes=num_classes, dtype=dtype)
     if model_name != "LeNet":
         kwargs["bn_axis_name"] = bn_axis_name
+    if model_name.startswith("ResNet"):
+        kwargs["remat"] = remat
+    elif remat:
+        raise ValueError(f"remat is only supported for the ResNet family, not {model_name!r}")
     return ctor(**kwargs)
 
 

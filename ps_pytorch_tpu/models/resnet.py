@@ -82,13 +82,19 @@ class Bottleneck(nn.Module):
 
 
 class ResNet(nn.Module):
-    """CIFAR ResNet trunk (resnet.py:67-97)."""
+    """CIFAR ResNet trunk (resnet.py:67-97).
+
+    `remat=True` rematerializes each residual block's activations in the
+    backward pass (flax nn.remat) — the deep Bottleneck variants at large
+    batch trade ~1/3 extra FLOPs for activation memory that otherwise
+    scales with depth."""
 
     block: Any
     num_blocks: Sequence[int]
     num_classes: int = 10
     dtype: Any = jnp.float32
     bn_axis_name: Optional[str] = None
+    remat: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = False):
@@ -98,16 +104,25 @@ class ResNet(nn.Module):
         )(x)
         x = batch_norm(train=train, dtype=self.dtype, bn_axis_name=self.bn_axis_name)(x)
         x = nn.relu(x)
+        block_cls = (
+            nn.remat(self.block, static_argnums=(2,)) if self.remat else self.block
+        )
+        # explicit names: nn.remat renames the class (BasicBlock ->
+        # CheckpointBasicBlock), which would silently re-key the param tree
+        # and break checkpoint exchange between remat and non-remat runs
+        block_idx = 0
         for stage, (planes, stride) in enumerate(
             zip((64, 128, 256, 512), (1, 2, 2, 2))
         ):
             for i in range(self.num_blocks[stage]):
-                x = self.block(
+                x = block_cls(
                     planes=planes,
                     stride=stride if i == 0 else 1,
                     dtype=self.dtype,
                     bn_axis_name=self.bn_axis_name,
-                )(x, train=train)
+                    name=f"{self.block.__name__}_{block_idx}",
+                )(x, train)
+                block_idx += 1
         x = nn.avg_pool(x, (4, 4), strides=(4, 4))
         x = x.reshape((x.shape[0], -1))
         x = nn.Dense(self.num_classes, dtype=self.dtype)(x)

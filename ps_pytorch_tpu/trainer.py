@@ -19,6 +19,8 @@ at step 1).
 from __future__ import annotations
 
 import dataclasses
+import json
+import os
 from typing import Optional
 
 import jax
@@ -46,6 +48,17 @@ from .parallel import (
 from .utils import PhaseTimer, format_eval_line, format_iter_line, get_logger
 
 logger = get_logger()
+
+
+def append_metrics_line(path: Optional[str], record: dict) -> None:
+    """Structured metrics sink (one JSON object per line). The reference
+    has only parseable log text (SURVEY.md section 5 'no TensorBoard/CSV');
+    this is the machine-readable channel next to it."""
+    if not path:
+        return
+    os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
+    with open(path, "a") as f:
+        f.write(json.dumps(record) + "\n")
 
 
 def average_metrics(step_fn, batches) -> dict:
@@ -88,6 +101,8 @@ class TrainConfig:
     allow_synthetic: bool = True
     shard_mode: str = "reshuffle"  # reference parity; "disjoint" improvement
     dtype: str = "float32"  # compute dtype: float32 | bfloat16 (MXU-native)
+    remat: bool = False  # per-block activation rematerialization (ResNets)
+    metrics_file: Optional[str] = None  # append one JSON line per logged step
     profile_dir: Optional[str] = None  # jax.profiler trace output (eval_freq window)
     # straggler watchdog (reference --kill-threshold, distributed_nn.py:52:
     # there it was meant to kill slow workers; under SPMD there is nothing
@@ -114,6 +129,7 @@ class Trainer:
             num_classes=self.dataset.num_classes,
             dtype=compute_dtype,
             bn_axis_name=pcfg.axis_name if pcfg.bn_mode == "synced" else None,
+            remat=tcfg.remat,
         )
         self.tx = build_optimizer(
             tcfg.optimizer,
@@ -254,6 +270,16 @@ class Trainer:
                                 forward=timer.durations.get("step", 0.0),
                             )
                         )
+                        append_metrics_line(
+                            t.metrics_file,
+                            {
+                                "kind": "train",
+                                "step": step_no,
+                                "epoch": epoch,
+                                "time_cost": round(timer.total, 6),
+                                **{k: float(v) for k, v in metrics.items()},
+                            },
+                        )
                     if t.save_checkpoints and step_no % t.eval_freq == 0:
                         self._ckpt.save(
                             self.state,
@@ -302,5 +328,8 @@ class Trainer:
             step_no = int(jax.device_get(self.state.step))
             logger.info(
                 format_eval_line(step_no, out["loss"], out["prec1"], out["prec5"])
+            )
+            append_metrics_line(
+                t.metrics_file, {"kind": "eval", "step": step_no, **out}
             )
         return out
