@@ -117,10 +117,12 @@ def main(argv=None) -> dict:
         idx = rng.randint(0, len(corpus), args.batch_size)
         tokens = shard_tokens_2d(jnp.asarray(corpus[idx]), mesh)
         params, opt_state, loss = step(params, opt_state, tokens)
-        dt = time.perf_counter() - t0
         if step_no % args.log_interval == 0 or step_no == 1:
-            # host sync only on logged steps — keep async dispatch otherwise
+            # host sync only on logged steps — keep async dispatch otherwise.
+            # The sync must happen BEFORE reading the clock: step() returns at
+            # dispatch time, so an unsynced dt measures enqueue, not compute.
             loss = float(loss)
+            dt = time.perf_counter() - t0
             logger.info(
                 format_iter_line(
                     rank="mesh", step=step_no, epoch=1,

@@ -25,6 +25,18 @@ from .ring_attention import (
     ring_attention,
     shard_sequence,
 )
+from .tp import (
+    TP_AXIS,
+    apply_transformer_tp,
+    from_tp_layout,
+    init_tp_state,
+    make_tp_forward,
+    make_tp_mesh,
+    make_tp_train_step,
+    shard_params_tp,
+    to_tp_layout,
+    tp_param_specs,
+)
 from .ps import (
     PSConfig,
     PSTrainState,

@@ -1,0 +1,290 @@
+"""Tensor (model) parallelism for the transformer family.
+
+The reference has no tensor parallelism (SURVEY.md section 2: "TP / PP / SP /
+EP / CP ... absent"); this module is part of making the mesh design
+future-proof beyond the reference's data-parallel-only scope. The layout is
+the standard Megatron split mapped onto XLA collectives:
+
+- attention: heads sharded over the `model` axis — `wqkv` is stored
+  [D, 3, H, hd] and sharded on H, so every device computes full attention
+  for its own heads with ZERO communication; `wo` is stored [H, hd, D]
+  (row-parallel) and the output projection ends in one `psum`.
+- MLP: `w_up` column-sharded [D, M/n] (independent GELUs), `w_down`
+  row-sharded [M/n, D], one `psum` after the down-projection.
+- embeddings / norms / logits: replicated (vocab is small in the
+  reference-scale configs; sharding the embedding is a future axis).
+
+Two psums per block per token — both ride ICI, both fused by XLA into the
+surrounding matmuls. Gradients w.r.t. sharded weights are naturally local
+(shard_map transposes the psum to a broadcast of the cotangent), so the
+optimizer runs shard-wise with no extra collectives: tensor-parallel
+training is `value_and_grad` + local optax update, exactly like the PS
+engine but with sharded instead of replicated state.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .ring_attention import full_attention
+
+# NOTE: ..models.transformer imports from this package (ring_attention), so
+# importing it at module top would be circular; TransformerConfig appears
+# only in (string) annotations and _rms_norm/init_transformer are imported
+# lazily inside the functions that use them.
+from typing import TYPE_CHECKING
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..models.transformer import TransformerConfig
+
+TP_AXIS = "model"
+
+
+def make_tp_mesh(
+    num_shards: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """1-D tensor-parallel mesh (axis 'model')."""
+    devs = list(devices if devices is not None else jax.devices())
+    n = num_shards if num_shards is not None else len(devs)
+    if n > len(devs):
+        raise ValueError(f"need {n} devices, have {len(devs)}")
+    return Mesh(np.array(devs[:n]), (TP_AXIS,))
+
+
+def to_tp_layout(cfg: TransformerConfig, params: Dict) -> Dict:
+    """Re-layout replicated transformer params for head/column sharding.
+
+    wqkv [D, 3D] -> [D, 3, H, hd]  (shard dim 2)
+    wo   [D, D]  -> [H, hd, D]     (shard dim 0)
+    w_up [D, M] stays               (shard dim 1)
+    w_down [M, D] stays             (shard dim 0)
+    """
+    h, hd = cfg.heads, cfg.head_dim
+    out = {k: v for k, v in params.items() if k != "blocks"}
+    out["blocks"] = []
+    for blk in params["blocks"]:
+        b = dict(blk)
+        b["wqkv"] = blk["wqkv"].reshape(cfg.dim, 3, h, hd)
+        b["wo"] = blk["wo"].reshape(h, hd, cfg.dim)
+        out["blocks"].append(b)
+    return out
+
+
+def from_tp_layout(cfg: TransformerConfig, params_tp: Dict) -> Dict:
+    """Inverse of `to_tp_layout` (for checkpoint interchange)."""
+    out = {k: v for k, v in params_tp.items() if k != "blocks"}
+    out["blocks"] = []
+    for blk in params_tp["blocks"]:
+        b = dict(blk)
+        b["wqkv"] = blk["wqkv"].reshape(cfg.dim, 3 * cfg.dim)
+        b["wo"] = blk["wo"].reshape(cfg.dim, cfg.dim)
+        out["blocks"].append(b)
+    return out
+
+
+def tp_param_specs(cfg: TransformerConfig, axis: str = TP_AXIS) -> Dict:
+    """PartitionSpec pytree matching `to_tp_layout` output."""
+    blk = {
+        "ln1": P(),
+        "wqkv": P(None, None, axis, None),
+        "wo": P(axis, None, None),
+        "ln2": P(),
+        "w_up": P(None, axis),
+        "w_down": P(axis, None),
+    }
+    return {
+        "embed": P(),
+        "pos_embed": P(),
+        "out_norm": P(),
+        "blocks": [dict(blk) for _ in range(cfg.depth)],
+    }
+
+
+def shard_params_tp(
+    cfg: TransformerConfig, params_tp: Dict, mesh: Mesh, axis: str = TP_AXIS
+) -> Dict:
+    """Place a TP-layout param tree on the mesh with the TP shardings."""
+    specs = tp_param_specs(cfg, axis)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params_tp,
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def apply_transformer_tp(
+    cfg: TransformerConfig,
+    params: Dict,  # TP layout, LOCAL shards (inside shard_map)
+    tokens: jax.Array,  # int32 [B, T] (replicated)
+    axis_name: str = TP_AXIS,
+) -> jax.Array:
+    """Forward on one model shard -> replicated logits [B, T, vocab].
+
+    Mirrors models/transformer.py:apply_transformer with the Megatron
+    split; every activation entering/leaving a block is replicated, so the
+    result is bit-identical (up to reduction order) to the single-device
+    model.
+    """
+    from ..models.transformer import _rms_norm
+
+    b, t = tokens.shape
+    pos = jnp.arange(t)
+    x = params["embed"][tokens] + params["pos_embed"][pos][None]
+
+    def block(x, blk):
+        h = _rms_norm(x, blk["ln1"])
+        qkv = jnp.einsum("btd,dchk->btchk", h, blk["wqkv"])  # [B,T,3,Hloc,hd]
+        q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+        o = full_attention(q, k, v, causal=cfg.causal)  # local heads only
+        proj = jnp.einsum("bthk,hkd->btd", o, blk["wo"])
+        x = x + lax.psum(proj, axis_name)
+        h = _rms_norm(x, blk["ln2"])
+        down = jax.nn.gelu(h @ blk["w_up"]) @ blk["w_down"]
+        return x + lax.psum(down, axis_name)
+
+    if cfg.remat:
+        block = jax.checkpoint(block)
+    for blk in params["blocks"]:
+        x = block(x, blk)
+    return _rms_norm(x, params["out_norm"]) @ params["embed"].T
+
+
+def make_tp_forward(
+    cfg: TransformerConfig, mesh: Mesh, axis_name: str = TP_AXIS, jit: bool = True
+):
+    """Tensor-parallel forward: params in TP layout (sharded per
+    `tp_param_specs`), tokens replicated -> replicated logits."""
+    mapped = jax.shard_map(
+        partial(apply_transformer_tp, cfg, axis_name=axis_name),
+        mesh=mesh,
+        in_specs=(tp_param_specs(cfg, axis_name), P()),
+        out_specs=P(),
+        check_vma=False,
+    )
+    return jax.jit(mapped) if jit else mapped
+
+
+def _is_replicated(spec: P) -> bool:
+    return all(a is None for a in spec)
+
+
+def opt_state_specs(opt_state, params, param_specs):
+    """Spec tree for an optax state: every sub-tree that structurally
+    matches the param tree (momentum/first/second-moment buffers) takes the
+    param specs; every other leaf (step counters, scalars) is replicated.
+
+    `opt_state` may be concrete arrays or `jax.eval_shape` output — only
+    the structure is used.
+    """
+    params_treedef = jax.tree.structure(params)
+
+    def walk(node):
+        try:
+            if jax.tree.structure(node) == params_treedef:
+                return param_specs
+        except Exception:
+            pass
+        if isinstance(node, tuple) and hasattr(node, "_fields"):  # NamedTuple
+            return type(node)(*(walk(c) for c in node))
+        if isinstance(node, (list, tuple)):
+            return type(node)(walk(c) for c in node)
+        if isinstance(node, dict):
+            return {k: walk(v) for k, v in node.items()}
+        return P()  # array leaf or None
+
+    return walk(opt_state)
+
+
+def _tp_param_shapes(cfg: TransformerConfig) -> Dict:
+    from ..models.transformer import init_transformer
+
+    shapes = jax.eval_shape(lambda: init_transformer(cfg, jax.random.key(0)))
+    return jax.eval_shape(partial(to_tp_layout, cfg), shapes)
+
+
+def init_tp_state(
+    cfg: TransformerConfig,
+    tx: optax.GradientTransformation,
+    key: jax.Array,
+    mesh: Mesh,
+    axis_name: str = TP_AXIS,
+):
+    """Init (params_tp, opt_state) already placed with TP shardings —
+    momentum buffers shard exactly like their parameters."""
+    from ..models.transformer import init_transformer
+
+    params_tp = shard_params_tp(
+        cfg, to_tp_layout(cfg, init_transformer(cfg, key)), mesh, axis_name
+    )
+    opt_state = tx.init(params_tp)
+    specs = opt_state_specs(opt_state, params_tp, tp_param_specs(cfg, axis_name))
+    opt_state = jax.tree.map(
+        lambda x, s: None if x is None else jax.device_put(x, NamedSharding(mesh, s)),
+        opt_state,
+        specs,
+        is_leaf=lambda x: x is None,
+    )
+    return params_tp, opt_state
+
+
+def make_tp_train_step(
+    cfg: TransformerConfig,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis_name: str = TP_AXIS,
+):
+    """Jitted TP LM train step: (params_tp, opt_state, tokens) ->
+    (params_tp, opt_state, loss). Params/opt state sharded over the model
+    axis; tokens replicated. Gradients for sharded weights are local, so
+    the optimizer update is shard-wise — no gradient collective at all
+    (the two in-block psums are the only communication)."""
+
+    specs_tree = tp_param_specs(cfg, axis_name)
+
+    def shard_fn(params, opt_state, tokens):
+        n = lax.axis_size(axis_name)
+
+        def loss_fn(p):
+            logits = apply_transformer_tp(cfg, p, tokens, axis_name)
+            logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+            tgt = tokens[:, 1:]
+            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+            # With check_vma=False, shard_map AD computes exact grads of the
+            # SUM over shards of the per-shard outputs (psum transposes to
+            # psum — the correct transpose of that global function). Every
+            # shard computes the identical loss, so differentiate loss/n:
+            # sharded leaves' grads come out exact; replicated leaves' grads
+            # come out as per-shard partials whose psum is exact (below).
+            return jnp.mean(nll) / n
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = jax.tree.map(
+            lambda g, s: lax.psum(g, axis_name) if _is_replicated(s) else g,
+            grads,
+            specs_tree,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt, loss * n
+
+    shapes = _tp_param_shapes(cfg)
+    opt_specs = opt_state_specs(jax.eval_shape(tx.init, shapes), shapes, specs_tree)
+    mapped = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(specs_tree, opt_specs, P()),
+        out_specs=(specs_tree, opt_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
