@@ -113,15 +113,17 @@ def main(argv=None) -> dict:
     rng = np.random.RandomState(args.seed + 2)
     loss = float("nan")
     for step_no in range(1, args.max_steps + 1):
+        log_now = step_no % args.log_interval == 0 or step_no == 1
+        if log_now:
+            # drain the async-dispatch backlog BEFORE starting the clock so
+            # dt measures ONE step, not the queue of unlogged steps
+            jax.block_until_ready(params)
         t0 = time.perf_counter()
         idx = rng.randint(0, len(corpus), args.batch_size)
         tokens = shard_tokens_2d(jnp.asarray(corpus[idx]), mesh)
         params, opt_state, loss = step(params, opt_state, tokens)
-        if step_no % args.log_interval == 0 or step_no == 1:
-            # host sync only on logged steps — keep async dispatch otherwise.
-            # The sync must happen BEFORE reading the clock: step() returns at
-            # dispatch time, so an unsynced dt measures enqueue, not compute.
-            loss = float(loss)
+        if log_now:
+            loss = float(loss)  # host sync: dt now spans exactly this step
             dt = time.perf_counter() - t0
             logger.info(
                 format_iter_line(

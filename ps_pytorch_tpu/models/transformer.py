@@ -89,6 +89,25 @@ def _rms_norm(x, gamma, eps=1e-6):
     return x * jax.lax.rsqrt(var + eps) * gamma
 
 
+def transformer_block(cfg: TransformerConfig, x, blk, attend):
+    """One pre-norm block: attention + GELU MLP, both residual.
+
+    The single source of the block math — apply_transformer (below) and the
+    pipeline-parallel schedule (parallel/pp.py) both run exactly this, so
+    the PP path can never desynchronize from the oracle it is tested
+    against. `attend` maps ([B,T,H,hd],)*3 -> [B,T,H,hd].
+    """
+    b, t = x.shape[0], x.shape[1]
+    h = _rms_norm(x, blk["ln1"])
+    qkv = h @ blk["wqkv"]
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    split_heads = lambda a: a.reshape(b, t, cfg.heads, cfg.head_dim)
+    o = attend(split_heads(q), split_heads(k), split_heads(v))
+    x = x + o.reshape(b, t, cfg.dim) @ blk["wo"]
+    h = _rms_norm(x, blk["ln2"])
+    return x + jax.nn.gelu(h @ blk["w_up"]) @ blk["w_down"]
+
+
 def apply_transformer(
     cfg: TransformerConfig,
     params: Dict,
@@ -120,14 +139,7 @@ def apply_transformer(
     x = params["embed"][tokens] + params["pos_embed"][pos][None]
 
     def block(x, blk):
-        h = _rms_norm(x, blk["ln1"])
-        qkv = h @ blk["wqkv"]
-        q, k, v = jnp.split(qkv, 3, axis=-1)
-        split_heads = lambda a: a.reshape(b, t_loc, cfg.heads, cfg.head_dim)
-        o = attend(split_heads(q), split_heads(k), split_heads(v))
-        x = x + o.reshape(b, t_loc, cfg.dim) @ blk["wo"]
-        h = _rms_norm(x, blk["ln2"])
-        return x + jax.nn.gelu(h @ blk["w_up"]) @ blk["w_down"]
+        return transformer_block(cfg, x, blk, attend)
 
     if cfg.remat:
         block = jax.checkpoint(block)

@@ -25,6 +25,15 @@ from .ring_attention import (
     ring_attention,
     shard_sequence,
 )
+from .pp import (
+    PP_AXIS,
+    from_pp_layout,
+    init_pp_state,
+    make_pp_mesh,
+    make_pp_train_step,
+    shard_params_pp,
+    to_pp_layout,
+)
 from .tp import (
     TP_AXIS,
     apply_transformer_tp,

@@ -1,0 +1,251 @@
+"""Pipeline (stage) parallelism for the transformer family.
+
+Absent from the reference (SURVEY.md section 2: "TP / PP / SP / EP / CP ...
+absent"); built here so model depth scales across the mesh. The schedule is
+GPipe mapped onto SPMD collectives:
+
+- the transformer's blocks are STACKED into [depth, ...] leaves and the
+  depth axis is sharded over the `stage` mesh axis — each device owns
+  depth/n_stages contiguous blocks and runs them with a local `lax.scan`;
+- the global batch is cut into M microbatches; one jitted `lax.scan` over
+  M + S - 1 ticks runs the pipeline: each tick every stage `ppermute`s its
+  previous activation to the next stage, stage 0 injects the next
+  microbatch's embedding, the last stage collects finished microbatches;
+- embeddings / norms / unembedding are replicated (stage 0 embeds, the
+  last stage projects to logits; psum completes the loss on all stages).
+
+Bubble fraction is the usual (S-1)/(M+S-1) — choose M >= S. All ticks are
+one compiled loop body (uniform control flow; `jnp.where` does the
+schedule gating), so XLA overlaps each tick's ppermute with the next
+tick's block compute where the hardware allows.
+
+Gradient correctness uses the same rule as parallel/tp.py: under
+shard_map(check_vma=False), AD computes exact gradients of the SUM over
+shards of the per-shard outputs, so the train step differentiates loss/S
+and psums the replicated leaves' gradients afterwards.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Dict, Optional, Sequence, TYPE_CHECKING
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .tp import opt_state_specs
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..models.transformer import TransformerConfig
+
+PP_AXIS = "stage"
+
+
+def make_pp_mesh(
+    num_stages: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """1-D pipeline mesh (axis 'stage')."""
+    from .mesh import make_mesh
+
+    return make_mesh(num_workers=num_stages, devices=devices, axis_name=PP_AXIS)
+
+
+def to_pp_layout(cfg: "TransformerConfig", params: Dict) -> Dict:
+    """Stack the per-block param dicts into [depth, ...] leaves so the
+    depth axis can be mesh-sharded and scanned."""
+    out = {k: v for k, v in params.items() if k != "blocks"}
+    out["blocks"] = jax.tree.map(lambda *xs: jnp.stack(xs), *params["blocks"])
+    return out
+
+
+def from_pp_layout(cfg: "TransformerConfig", params_pp: Dict) -> Dict:
+    """Inverse of `to_pp_layout` (checkpoint interchange)."""
+    out = {k: v for k, v in params_pp.items() if k != "blocks"}
+    out["blocks"] = [
+        jax.tree.map(lambda x: x[i], params_pp["blocks"])
+        for i in range(cfg.depth)
+    ]
+    return out
+
+
+def pp_param_specs(cfg: "TransformerConfig", axis: str = PP_AXIS) -> Dict:
+    """Stacked blocks shard their leading (depth) dim over the stage axis;
+    everything else is replicated."""
+    blk = {
+        "ln1": P(axis),
+        "wqkv": P(axis),
+        "wo": P(axis),
+        "ln2": P(axis),
+        "w_up": P(axis),
+        "w_down": P(axis),
+    }
+    return {"embed": P(), "pos_embed": P(), "out_norm": P(), "blocks": blk}
+
+
+def shard_params_pp(
+    cfg: "TransformerConfig", params_pp: Dict, mesh: Mesh, axis: str = PP_AXIS
+) -> Dict:
+    n = mesh.shape[axis]
+    if cfg.depth % n:
+        raise ValueError(f"depth {cfg.depth} not divisible by {n} stages")
+    specs = pp_param_specs(cfg, axis)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params_pp,
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def _block(cfg: "TransformerConfig", x, blk):
+    """One transformer block — the same function the oracle runs."""
+    from ..models.transformer import transformer_block
+    from .ring_attention import full_attention
+
+    attend = partial(full_attention, causal=cfg.causal)
+    return transformer_block(cfg, x, blk, attend)
+
+
+def _pp_logits_and_loss(
+    cfg: "TransformerConfig",
+    params: Dict,  # PP layout, LOCAL shards (inside shard_map)
+    tokens: jax.Array,  # int32 [M, B_mb, T] microbatched, replicated
+    axis_name: str,
+):
+    """Run the pipeline schedule; returns the scalar mean next-token loss
+    (identical on every stage, via psum of the last stage's value)."""
+    from ..models.transformer import _rms_norm
+
+    n = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    m, b_mb, t = tokens.shape
+    pos = jnp.arange(t)
+
+    def local_blocks(x):
+        body = lambda x, blk: (_block(cfg, x, blk), None)
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        x, _ = lax.scan(body, x, params["blocks"])
+        return x
+
+    def embed(mb_idx):
+        tok = lax.dynamic_index_in_dim(
+            tokens, jnp.clip(mb_idx, 0, m - 1), 0, keepdims=False
+        )
+        return params["embed"][tok] + params["pos_embed"][pos][None]
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    outputs0 = jnp.zeros((m, b_mb, t, cfg.dim), params["embed"].dtype)
+    y0 = jnp.zeros((b_mb, t, cfg.dim), params["embed"].dtype)
+
+    def tick(carry, tk):
+        y, outputs = carry
+        inbound = lax.ppermute(y, axis_name, perm)
+        x_in = jnp.where(stage == 0, embed(tk), inbound)
+        y_new = local_blocks(x_in)
+        done = tk - (n - 1)
+        outputs = jnp.where(
+            (done >= 0) & (done < m),
+            lax.dynamic_update_index_in_dim(
+                outputs, y_new[None], jnp.clip(done, 0, m - 1), 0
+            ),
+            outputs,
+        )
+        return (y_new, outputs), None
+
+    (_, outputs), _ = lax.scan(tick, (y0, outputs0), jnp.arange(m + n - 1))
+
+    # unembed + loss on the last stage (computed uniformly on all stages;
+    # only the last stage's value survives the mask+psum)
+    xf = _rms_norm(outputs, params["out_norm"])
+    logits = xf @ params["embed"].T  # [M, B_mb, T, V]
+    logp = jax.nn.log_softmax(logits[:, :, :-1].astype(jnp.float32))
+    tgt = tokens[:, :, 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+    loss_local = jnp.mean(nll)
+    return lax.psum(jnp.where(stage == n - 1, loss_local, 0.0), axis_name)
+
+
+def make_pp_train_step(
+    cfg: "TransformerConfig",
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    num_microbatches: int,
+    axis_name: str = PP_AXIS,
+):
+    """Jitted PP LM train step: (params_pp, opt_state, tokens [B, T]) ->
+    (params_pp, opt_state, loss). Block params/opt state sharded over the
+    stage axis; tokens replicated and cut into `num_microbatches` equal
+    microbatches inside the step."""
+    specs_tree = pp_param_specs(cfg, axis_name)
+
+    def shard_fn(params, opt_state, tokens):
+        n = lax.axis_size(axis_name)
+        bsz, t = tokens.shape
+        if bsz % num_microbatches:  # static shape: raises at trace time
+            raise ValueError(
+                f"batch {bsz} not divisible by {num_microbatches} microbatches"
+            )
+        mb = tokens.reshape(num_microbatches, bsz // num_microbatches, t)
+
+        # same AD rule as tp.py: grads of sum-over-shards => scale by 1/n,
+        # then psum the replicated leaves' partial grads
+        loss, grads = jax.value_and_grad(
+            lambda p: _pp_logits_and_loss(cfg, p, mb, axis_name) / n
+        )(params)
+        grads = jax.tree.map(
+            lambda g, s: lax.psum(g, axis_name) if s == P() else g,
+            grads,
+            specs_tree,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt, loss * n
+
+    shapes = _pp_param_shapes(cfg)
+    opt_specs = opt_state_specs(jax.eval_shape(tx.init, shapes), shapes, specs_tree)
+    mapped = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(specs_tree, opt_specs, P()),
+        out_specs=(specs_tree, opt_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def _pp_param_shapes(cfg: "TransformerConfig") -> Dict:
+    from ..models.transformer import init_transformer
+
+    shapes = jax.eval_shape(lambda: init_transformer(cfg, jax.random.key(0)))
+    return jax.eval_shape(partial(to_pp_layout, cfg), shapes)
+
+
+def init_pp_state(
+    cfg: "TransformerConfig",
+    tx: optax.GradientTransformation,
+    key: jax.Array,
+    mesh: Mesh,
+    axis_name: str = PP_AXIS,
+):
+    """Init (params_pp, opt_state) placed with PP shardings."""
+    from ..models.transformer import init_transformer
+
+    params_pp = shard_params_pp(
+        cfg, to_pp_layout(cfg, init_transformer(cfg, key)), mesh, axis_name
+    )
+    opt_state = tx.init(params_pp)
+    specs = opt_state_specs(opt_state, params_pp, pp_param_specs(cfg, axis_name))
+    opt_state = jax.tree.map(
+        lambda x, s: None if x is None else jax.device_put(x, NamedSharding(mesh, s)),
+        opt_state,
+        specs,
+        is_leaf=lambda x: x is None,
+    )
+    return params_pp, opt_state

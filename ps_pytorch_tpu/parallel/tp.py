@@ -53,11 +53,9 @@ def make_tp_mesh(
     devices: Optional[Sequence[jax.Device]] = None,
 ) -> Mesh:
     """1-D tensor-parallel mesh (axis 'model')."""
-    devs = list(devices if devices is not None else jax.devices())
-    n = num_shards if num_shards is not None else len(devs)
-    if n > len(devs):
-        raise ValueError(f"need {n} devices, have {len(devs)}")
-    return Mesh(np.array(devs[:n]), (TP_AXIS,))
+    from .mesh import make_mesh
+
+    return make_mesh(num_workers=num_shards, devices=devices, axis_name=TP_AXIS)
 
 
 def to_tp_layout(cfg: TransformerConfig, params: Dict) -> Dict:
@@ -113,6 +111,13 @@ def shard_params_tp(
     cfg: TransformerConfig, params_tp: Dict, mesh: Mesh, axis: str = TP_AXIS
 ) -> Dict:
     """Place a TP-layout param tree on the mesh with the TP shardings."""
+    n = mesh.shape[axis]
+    if cfg.heads % n:
+        raise ValueError(f"heads {cfg.heads} not divisible by {n} model shards")
+    if (cfg.dim * cfg.mlp_ratio) % n:
+        raise ValueError(
+            f"mlp dim {cfg.dim * cfg.mlp_ratio} not divisible by {n} model shards"
+        )
     specs = tp_param_specs(cfg, axis)
     return jax.tree.map(
         lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
