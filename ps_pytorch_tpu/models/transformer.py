@@ -89,13 +89,15 @@ def _rms_norm(x, gamma, eps=1e-6):
     return x * jax.lax.rsqrt(var + eps) * gamma
 
 
-def transformer_block(cfg: TransformerConfig, x, blk, attend):
+def transformer_block(cfg: TransformerConfig, x, blk, attend, mlp=None):
     """One pre-norm block: attention + GELU MLP, both residual.
 
-    The single source of the block math — apply_transformer (below) and the
-    pipeline-parallel schedule (parallel/pp.py) both run exactly this, so
-    the PP path can never desynchronize from the oracle it is tested
-    against. `attend` maps ([B,T,H,hd],)*3 -> [B,T,H,hd].
+    The single source of the block math — apply_transformer (below), the
+    pipeline-parallel schedule (parallel/pp.py), and the MoE transformer
+    (parallel/moe.py, via `mlp`) all run exactly this, so no parallel path
+    can desynchronize from the oracle it is tested against.
+    `attend` maps ([B,T,H,hd],)*3 -> [B,T,H,hd]; `mlp` (optional) replaces
+    the dense GELU MLP, mapping the normed hidden [B,T,D] -> [B,T,D].
     """
     b, t = x.shape[0], x.shape[1]
     h = _rms_norm(x, blk["ln1"])
@@ -105,6 +107,8 @@ def transformer_block(cfg: TransformerConfig, x, blk, attend):
     o = attend(split_heads(q), split_heads(k), split_heads(v))
     x = x + o.reshape(b, t, cfg.dim) @ blk["wo"]
     h = _rms_norm(x, blk["ln2"])
+    if mlp is not None:
+        return x + mlp(h)
     return x + jax.nn.gelu(h @ blk["w_up"]) @ blk["w_down"]
 
 

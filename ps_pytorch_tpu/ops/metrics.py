@@ -22,6 +22,20 @@ def cross_entropy_loss(logits: jax.Array, labels: jax.Array) -> jax.Array:
     return jnp.mean(nll)
 
 
+def next_token_nll(logits: jax.Array, tokens: jax.Array) -> jax.Array:
+    """Mean next-token negative log-likelihood for an LM batch.
+
+    logits [B, T, V] (position t predicts token t+1), tokens int32 [B, T].
+    The single source of the LM loss used by the tensor-, pipeline-, and
+    expert-parallel train steps (leading batch-like dims beyond [B] are
+    folded in, so [M, B_mb, T] microbatched logits work unchanged).
+    """
+    logp = jax.nn.log_softmax(logits[..., :-1, :].astype(jnp.float32), axis=-1)
+    tgt = tokens[..., 1:]
+    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)
+    return jnp.mean(nll)
+
+
 def accuracy(
     logits: jax.Array, labels: jax.Array, topk: Sequence[int] = (1,)
 ) -> Tuple[jax.Array, ...]:

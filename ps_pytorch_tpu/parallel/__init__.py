@@ -25,6 +25,16 @@ from .ring_attention import (
     ring_attention,
     shard_sequence,
 )
+from .moe import (
+    EP_AXIS,
+    MoEConfig,
+    apply_moe_transformer,
+    init_moe_state,
+    make_ep_mesh,
+    make_moe_train_step,
+    shard_moe_batch,
+    shard_params_moe,
+)
 from .pp import (
     PP_AXIS,
     from_pp_layout,

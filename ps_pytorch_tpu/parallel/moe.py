@@ -1,0 +1,326 @@
+"""Mixture-of-Experts with expert parallelism (all_to_all dispatch).
+
+Absent from the reference (SURVEY.md section 2: "TP / PP / SP / EP / CP ...
+absent"); built here to complete the mesh's parallelism axes. The design is
+the Switch-Transformer / Mesh-TensorFlow formulation mapped onto XLA
+collectives:
+
+- every block's dense MLP is replaced by E experts (stacked [E, D, M] /
+  [E, M, D] weights, sharded over the `expert` mesh axis — each device owns
+  E/n experts);
+- the batch is sharded over the SAME axis (the expert axis doubles as data
+  parallelism outside the MoE region);
+- top-1 gating with capacity C = ceil(tokens_local * capacity_factor / E):
+  per (token, expert) dispatch/combine tensors built with a one-hot cumsum
+  rank (overflowing tokens are dropped — they ride the residual only, the
+  standard Switch behavior);
+- dispatch: einsum to [E, C, D] -> `lax.all_to_all` (split E over devices,
+  concatenate senders) -> [E/n, n*C, D] expert compute -> all_to_all back
+  -> combine-weighted sum. Two all_to_alls per MoE layer, both on ICI.
+- a Switch-style load-balance auxiliary loss (E * sum f_e p_e) is returned
+  alongside the task loss.
+
+Gradients: same shard_map AD rule as tp.py/pp.py — each shard returns its
+LOCAL loss; AD computes exact grads of the sum over shards; differentiate
+local/n, then psum the replicated leaves (all_to_all's transpose is
+all_to_all, which is exact under this convention).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Dict, Optional, Sequence, TYPE_CHECKING
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..ops.metrics import next_token_nll
+from .tp import opt_state_specs
+
+if TYPE_CHECKING:  # pragma: no cover
+    from ..models.transformer import TransformerConfig
+
+EP_AXIS = "expert"
+
+
+@dataclasses.dataclass(frozen=True)
+class MoEConfig:
+    """MoE knobs layered on top of a TransformerConfig."""
+
+    num_experts: int = 8
+    capacity_factor: float = 1.25
+    aux_loss_weight: float = 0.01
+
+
+def make_ep_mesh(
+    num_shards: Optional[int] = None,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """1-D expert-parallel mesh (axis 'expert')."""
+    from .mesh import make_mesh
+
+    return make_mesh(num_workers=num_shards, devices=devices, axis_name=EP_AXIS)
+
+
+def init_moe_params(
+    cfg: "TransformerConfig", moe: MoEConfig, key: jax.Array
+) -> Dict:
+    """Transformer params with every block's dense MLP replaced by a gate
+    + stacked expert weights."""
+    from ..models.transformer import init_transformer
+
+    params = init_transformer(cfg, key)
+    mlp_dim = cfg.dim * cfg.mlp_ratio
+    e = moe.num_experts
+    for i, blk in enumerate(params["blocks"]):
+        bk = jax.random.split(jax.random.fold_in(key, 1000 + i), 3)
+        del blk["w_up"], blk["w_down"]
+        scale = 1.0 / (cfg.dim ** 0.5)
+        blk["wg"] = (jax.random.normal(bk[0], (cfg.dim, e)) * scale).astype(
+            cfg.dtype
+        )
+        blk["w_up_e"] = (
+            jax.random.normal(bk[1], (e, cfg.dim, mlp_dim)) * scale
+        ).astype(cfg.dtype)
+        blk["w_down_e"] = (
+            jax.random.normal(bk[2], (e, mlp_dim, cfg.dim)) * (1.0 / mlp_dim ** 0.5)
+        ).astype(cfg.dtype)
+    return params
+
+
+def moe_param_specs(cfg: "TransformerConfig", axis: str = EP_AXIS) -> Dict:
+    blk = {
+        "ln1": P(),
+        "wqkv": P(),
+        "wo": P(),
+        "ln2": P(),
+        "wg": P(),
+        "w_up_e": P(axis),
+        "w_down_e": P(axis),
+    }
+    return {
+        "embed": P(),
+        "pos_embed": P(),
+        "out_norm": P(),
+        "blocks": [dict(blk) for _ in range(cfg.depth)],
+    }
+
+
+def shard_params_moe(
+    cfg: "TransformerConfig", params: Dict, mesh: Mesh, axis: str = EP_AXIS
+) -> Dict:
+    n = mesh.shape[axis]
+    e = params["blocks"][0]["w_up_e"].shape[0]
+    if e % n:
+        raise ValueError(f"{e} experts not divisible by {n} expert shards")
+    specs = moe_param_specs(cfg, axis)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
+        params,
+        specs,
+        is_leaf=lambda x: isinstance(x, P),
+    )
+
+
+def _gate_and_dispatch(x2d, wg, capacity):
+    """Top-1 gating over flat tokens [N, D].
+
+    Returns (dispatch [N, E, C] float {0,1}, combine [N, E, C], aux scalar).
+    """
+    logits = x2d @ wg  # [N, E]
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    expert = jnp.argmax(probs, axis=-1)  # [N]
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
+    e = wg.shape[-1]
+    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # [N, E]
+    # position of each token within its expert's queue (0-based)
+    rank = jnp.cumsum(onehot, axis=0) * onehot - onehot  # [N, E]
+    kept = (rank < capacity) * onehot  # drop overflow
+    pos = jax.nn.one_hot(jnp.sum(rank * onehot, axis=-1), capacity,
+                         dtype=jnp.float32)  # [N, C]
+    dispatch = kept[:, :, None] * pos[:, None, :]  # [N, E, C]
+    combine = dispatch * gate[:, None, None]
+    # Switch aux loss: E * sum_e (fraction routed to e) * (mean prob of e)
+    f = jnp.mean(onehot, axis=0)
+    p = jnp.mean(probs, axis=0)
+    aux = e * jnp.sum(f * p)
+    return dispatch, combine, aux
+
+
+def moe_mlp_local(h, blk, moe: MoEConfig, axis_name: Optional[str]):
+    """MoE MLP on local tokens h [B, T, D]; returns ([B, T, D], aux).
+
+    With axis_name=None this is the single-device (all experts local)
+    oracle; inside shard_map the two all_to_alls route tokens to the
+    devices owning their experts and back.
+    """
+    b, t, d = h.shape
+    x2d = h.reshape(b * t, d)
+    e = moe.num_experts
+    capacity = int(np.ceil(b * t * moe.capacity_factor / e))
+    dispatch, combine, aux = _gate_and_dispatch(x2d, blk["wg"], capacity)
+    # gating runs in f32; the dispatch/combine one-hots drop back to the
+    # activation dtype so the expert matmuls stay on the bf16 path
+    dispatch = dispatch.astype(h.dtype)
+    combine = combine.astype(h.dtype)
+    expert_in = jnp.einsum("nec,nd->ecd", dispatch, x2d)  # [E, C, D]
+
+    if axis_name is None:
+        w_up, w_down = blk["w_up_e"], blk["w_down_e"]
+        expert_out = jnp.einsum(
+            "ecm,emd->ecd",
+            jax.nn.gelu(jnp.einsum("ecd,edm->ecm", expert_in, w_up)),
+            w_down,
+        )
+    else:
+        # to expert owners: split E, concat senders' capacity slots
+        expert_in = lax.all_to_all(
+            expert_in, axis_name, split_axis=0, concat_axis=1, tiled=True
+        )  # [E/n, n*C, D]
+        w_up, w_down = blk["w_up_e"], blk["w_down_e"]  # local [E/n, ...]
+        expert_out = jnp.einsum(
+            "ecm,emd->ecd",
+            jax.nn.gelu(jnp.einsum("ecd,edm->ecm", expert_in, w_up)),
+            w_down,
+        )
+        # back to token owners
+        expert_out = lax.all_to_all(
+            expert_out, axis_name, split_axis=1, concat_axis=0, tiled=True
+        )  # [E, C, D]
+
+    out = jnp.einsum("nec,ecd->nd", combine, expert_out)
+    return out.reshape(b, t, d).astype(h.dtype), aux
+
+
+def apply_moe_transformer(
+    cfg: "TransformerConfig",
+    moe: MoEConfig,
+    params: Dict,
+    tokens: jax.Array,  # int32 [B_local, T]
+    axis_name: Optional[str] = None,
+) -> tuple:
+    """Forward -> (logits [B_local, T, vocab], mean aux loss)."""
+    from ..models.transformer import _rms_norm, transformer_block
+    from .ring_attention import full_attention
+
+    b, t = tokens.shape
+    pos = jnp.arange(t)
+    x = params["embed"][tokens] + params["pos_embed"][pos][None]
+    attend = partial(full_attention, causal=cfg.causal)
+
+    def block_fn(x, blk):
+        # transformer_block calls mlp(h) exactly once; the cell carries the
+        # aux loss out of the callback and returns it as a proper output
+        # (so jax.checkpoint can wrap the whole block)
+        aux_cell = []
+
+        def mlp(h):
+            out, aux = moe_mlp_local(h, blk, moe, axis_name)
+            aux_cell.append(aux)
+            return out
+
+        x = transformer_block(cfg, x, blk, attend, mlp=mlp)
+        return x, aux_cell[0]
+
+    if cfg.remat:
+        block_fn = jax.checkpoint(block_fn)
+
+    aux_total = 0.0
+    for blk in params["blocks"]:
+        x, aux = block_fn(x, blk)
+        aux_total = aux_total + aux
+
+    logits = _rms_norm(x, params["out_norm"]) @ params["embed"].T
+    return logits, aux_total / cfg.depth
+
+
+def make_moe_train_step(
+    cfg: "TransformerConfig",
+    moe: MoEConfig,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    axis_name: str = EP_AXIS,
+):
+    """Jitted MoE LM train step: (params, opt_state, tokens [B, T]) ->
+    (params, opt_state, loss, aux). Expert weights + batch sharded over the
+    expert axis; everything else replicated (the axis is simultaneously the
+    data-parallel axis)."""
+    specs_tree = moe_param_specs(cfg, axis_name)
+
+    def shard_fn(params, opt_state, tokens):
+        n = lax.axis_size(axis_name)
+
+        def loss_fn(p):
+            logits, aux = apply_moe_transformer(cfg, moe, p, tokens, axis_name)
+            task = next_token_nll(logits, tokens)
+            local = task + moe.aux_loss_weight * aux
+            # sum-over-shards AD rule (see module docstring): local/n
+            return local / n, (task, aux)
+
+        (_, (task_loss, aux)), grads = jax.value_and_grad(
+            loss_fn, has_aux=True
+        )(params)
+        grads = jax.tree.map(
+            lambda g, s: lax.psum(g, axis_name) if s == P() else g,
+            grads,
+            specs_tree,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return (
+            new_params,
+            new_opt,
+            lax.pmean(task_loss, axis_name),
+            lax.pmean(aux, axis_name),
+        )
+
+    shapes = _moe_param_shapes(cfg, moe)
+    opt_specs = opt_state_specs(jax.eval_shape(tx.init, shapes), shapes, specs_tree)
+    mapped = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(specs_tree, opt_specs, P(axis_name)),
+        out_specs=(specs_tree, opt_specs, P(), P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
+
+
+def _moe_param_shapes(cfg: "TransformerConfig", moe: MoEConfig) -> Dict:
+    return jax.eval_shape(
+        lambda: init_moe_params(cfg, moe, jax.random.key(0))
+    )
+
+
+def init_moe_state(
+    cfg: "TransformerConfig",
+    moe: MoEConfig,
+    tx: optax.GradientTransformation,
+    key: jax.Array,
+    mesh: Mesh,
+    axis_name: str = EP_AXIS,
+):
+    """Init (params, opt_state) placed with EP shardings."""
+    params = shard_params_moe(
+        cfg, init_moe_params(cfg, moe, key), mesh, axis_name
+    )
+    opt_state = tx.init(params)
+    specs = opt_state_specs(opt_state, params, moe_param_specs(cfg, axis_name))
+    opt_state = jax.tree.map(
+        lambda x, s: None if x is None else jax.device_put(x, NamedSharding(mesh, s)),
+        opt_state,
+        specs,
+        is_leaf=lambda x: x is None,
+    )
+    return params, opt_state
+
+
+def shard_moe_batch(tokens, mesh: Mesh, axis_name: str = EP_AXIS):
+    """[B_global, T] -> B sharded over the expert axis."""
+    return jax.device_put(tokens, NamedSharding(mesh, P(axis_name)))

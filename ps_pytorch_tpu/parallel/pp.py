@@ -37,6 +37,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.metrics import next_token_nll
 from .tp import opt_state_specs
 
 if TYPE_CHECKING:  # pragma: no cover
@@ -164,10 +165,7 @@ def _pp_logits_and_loss(
     # only the last stage's value survives the mask+psum)
     xf = _rms_norm(outputs, params["out_norm"])
     logits = xf @ params["embed"].T  # [M, B_mb, T, V]
-    logp = jax.nn.log_softmax(logits[:, :, :-1].astype(jnp.float32))
-    tgt = tokens[:, :, 1:]
-    nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)
-    loss_local = jnp.mean(nll)
+    loss_local = next_token_nll(logits, tokens)
     return lax.psum(jnp.where(stage == n - 1, loss_local, 0.0), axis_name)
 
 

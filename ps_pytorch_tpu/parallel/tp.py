@@ -34,6 +34,7 @@ import optax
 from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from ..ops.metrics import next_token_nll
 from .ring_attention import full_attention
 
 # NOTE: ..models.transformer imports from this package (ring_attention), so
@@ -261,16 +262,13 @@ def make_tp_train_step(
 
         def loss_fn(p):
             logits = apply_transformer_tp(cfg, p, tokens, axis_name)
-            logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
-            tgt = tokens[:, 1:]
-            nll = -jnp.take_along_axis(logp, tgt[..., None], axis=-1)
             # With check_vma=False, shard_map AD computes exact grads of the
             # SUM over shards of the per-shard outputs (psum transposes to
             # psum — the correct transpose of that global function). Every
             # shard computes the identical loss, so differentiate loss/n:
             # sharded leaves' grads come out exact; replicated leaves' grads
             # come out as per-shard partials whose psum is exact (below).
-            return jnp.mean(nll) / n
+            return next_token_nll(logits, tokens) / n
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
         grads = jax.tree.map(

@@ -116,6 +116,21 @@ def test_pp_training_decreases_loss_and_keeps_sharding(pp_mesh):
     assert buf.sharding.spec[0] == PP_AXIS
 
 
+def test_pp_multiple_blocks_per_stage_matches():
+    """4 stages x 2 blocks each: pins the per-stage lax.scan over stacked
+    blocks (ordering within a stage) that the depth==stages tests skip."""
+    mesh4 = make_pp_mesh(4)
+    params = init_transformer(CFG, jax.random.key(5))
+    tokens = _tokens(5)
+    want = float(_oracle_loss(CFG, params, tokens))
+    tx = sgd(0.0)
+    params_pp = shard_params_pp(CFG, to_pp_layout(CFG, params), mesh4)
+    step = make_pp_train_step(CFG, tx, mesh4, num_microbatches=2)
+    _, _, loss = step(params_pp, tx.init(params_pp), tokens)
+    assert abs(float(loss) - want) < 2e-5, (float(loss), want)
+    assert params_pp["blocks"]["wqkv"].addressable_shards[0].data.shape[0] == 2
+
+
 def test_pp_remat_matches(pp_mesh):
     cfg = TransformerConfig(
         vocab_size=53, dim=32, depth=8, heads=4, max_seq_len=16, remat=True
