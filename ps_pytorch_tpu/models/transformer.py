@@ -43,6 +43,10 @@ class TransformerConfig:
     # rotate K/V both ways on the sequence ring (half the sequential hops,
     # both ICI directions of a physical ring) — see parallel/ring_attention
     bidirectional_ring: bool = False
+    # sequence-parallel attention scheme: "ring" (K/V rotation, any head
+    # count) or "ulysses" (two all_to_alls, heads % axis_size == 0) — see
+    # parallel/ulysses.py for the trade-off
+    sp_attention: str = "ring"
 
     @property
     def head_dim(self) -> int:
@@ -128,12 +132,21 @@ def apply_transformer(
     b, t_loc = tokens.shape
     if seq_axis_name is not None:
         shard = jax.lax.axis_index(seq_axis_name) * t_loc
-        attend = partial(
-            ring_attention,
-            axis_name=seq_axis_name,
-            causal=cfg.causal,
-            bidirectional=cfg.bidirectional_ring,
-        )
+        if cfg.sp_attention == "ulysses":
+            from ..parallel.ulysses import ulysses_attention
+
+            attend = partial(
+                ulysses_attention, axis_name=seq_axis_name, causal=cfg.causal
+            )
+        elif cfg.sp_attention == "ring":
+            attend = partial(
+                ring_attention,
+                axis_name=seq_axis_name,
+                causal=cfg.causal,
+                bidirectional=cfg.bidirectional_ring,
+            )
+        else:
+            raise ValueError(f"unknown sp_attention {cfg.sp_attention!r}")
     else:
         shard = 0
         attend = partial(full_attention, causal=cfg.causal)

@@ -56,6 +56,10 @@ from .tp import (
     to_tp_layout,
     tp_param_specs,
 )
+from .ulysses import (
+    make_ulysses_attention,
+    ulysses_attention,
+)
 from .ps import (
     PSConfig,
     PSTrainState,

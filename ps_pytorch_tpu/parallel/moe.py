@@ -170,24 +170,18 @@ def moe_mlp_local(h, blk, moe: MoEConfig, axis_name: Optional[str]):
     combine = combine.astype(h.dtype)
     expert_in = jnp.einsum("nec,nd->ecd", dispatch, x2d)  # [E, C, D]
 
-    if axis_name is None:
-        w_up, w_down = blk["w_up_e"], blk["w_down_e"]
-        expert_out = jnp.einsum(
-            "ecm,emd->ecd",
-            jax.nn.gelu(jnp.einsum("ecd,edm->ecm", expert_in, w_up)),
-            w_down,
-        )
-    else:
+    if axis_name is not None:
         # to expert owners: split E, concat senders' capacity slots
         expert_in = lax.all_to_all(
             expert_in, axis_name, split_axis=0, concat_axis=1, tiled=True
         )  # [E/n, n*C, D]
-        w_up, w_down = blk["w_up_e"], blk["w_down_e"]  # local [E/n, ...]
-        expert_out = jnp.einsum(
-            "ecm,emd->ecd",
-            jax.nn.gelu(jnp.einsum("ecd,edm->ecm", expert_in, w_up)),
-            w_down,
-        )
+    w_up, w_down = blk["w_up_e"], blk["w_down_e"]  # local experts
+    expert_out = jnp.einsum(
+        "ecm,emd->ecd",
+        jax.nn.gelu(jnp.einsum("ecd,edm->ecm", expert_in, w_up)),
+        w_down,
+    )
+    if axis_name is not None:
         # back to token owners
         expert_out = lax.all_to_all(
             expert_out, axis_name, split_axis=1, concat_axis=0, tiled=True

@@ -1,0 +1,84 @@
+"""Ulysses (all-to-all) sequence parallelism — the alternative long-context
+scheme to the ring (parallel/ring_attention.py).
+
+DeepSpeed-Ulysses layout: activations are sequence-sharded [B, T/n, H, D]
+everywhere except inside attention. Two `lax.all_to_all`s per attention
+call re-shard seq->heads and back:
+
+    [B, T/n, H, D] --a2a(split H, concat T)--> [B, T, H/n, D]
+        full softmax attention over the COMPLETE sequence, local heads
+    [B, T, H/n, D] --a2a(split T, concat H)--> [B, T/n, H, D]
+
+versus the ring's n-1 neighbor hops: Ulysses moves each token exactly
+twice (O(T·D/n) per device per a2a, head-count must divide the axis) while
+the ring moves K/V n-1 times but keeps heads whole. On a TPU torus the
+ring rides neighbor ICI links; Ulysses uses the switched all_to_all —
+which one wins is sequence-length- and topology-dependent, so both are
+first-class here and share the same transformer (TransformerConfig.
+sp_attention selects the scheme; everything else is identical).
+
+The reference has no sequence dimension at all (SURVEY.md section 5
+"Long-context / sequence parallelism — absent"); both schemes are
+capability extensions with no counterpart to cite.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Optional
+
+import jax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .ring_attention import SEQ_AXIS, full_attention
+
+
+def ulysses_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = SEQ_AXIS,
+    causal: bool = False,
+    scale: Optional[float] = None,
+) -> jax.Array:
+    """Exact attention over sequence shards via two all_to_alls.
+
+    Call inside shard_map with q/k/v sharded [B, T_local, H, D] along the
+    sequence axis. Requires H % axis_size == 0. Returns the local output
+    shard [B, T_local, H, D].
+    """
+    n = lax.axis_size(axis_name)
+    h = q.shape[2]
+    if h % n:
+        raise ValueError(f"heads {h} not divisible by sequence axis size {n}")
+
+    def seq_to_heads(x):  # [B, T/n, H, D] -> [B, T, H/n, D]
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1,
+                              tiled=True)
+
+    def heads_to_seq(x):  # [B, T, H/n, D] -> [B, T/n, H, D]
+        return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
+                              tiled=True)
+
+    o = full_attention(
+        seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
+        causal=causal, scale=scale,
+    )
+    return heads_to_seq(o)
+
+
+def make_ulysses_attention(
+    mesh: Mesh, axis_name: str = SEQ_AXIS, causal: bool = False
+):
+    """Jitted sequence-sharded attention: (q, k, v) [B, T, H, D] global ->
+    [B, T, H, D] global, T sharded over the mesh axis (same contract as
+    ring_attention.make_ring_attention)."""
+    mapped = jax.shard_map(
+        partial(ulysses_attention, axis_name=axis_name, causal=causal),
+        mesh=mesh,
+        in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
+        out_specs=P(None, axis_name),
+        check_vma=False,
+    )
+    return jax.jit(mapped)
