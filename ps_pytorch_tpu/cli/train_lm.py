@@ -1,10 +1,15 @@
-"""Long-context LM training entry — the 2-D (data x sequence) parallel path.
+"""LM training entry — every transformer parallelism axis as a product
+surface, selected by --parallelism:
 
-No reference counterpart (SURVEY.md section 5: long context is absent
-there); this CLI makes the framework's sequence-parallel capability a
-product surface rather than a library: a transformer LM trained over a
-('workers', 'seq') mesh with ring attention, next-token targets fetched
-across shard boundaries, optional per-block remat and bidirectional ring.
+- dp_sp (default): 2-D (data x sequence) mesh, ring or Ulysses attention
+  (--sp-attention), next-token targets fetched across shard boundaries
+- tp: Megatron tensor parallelism (heads/MLP columns over a 'model' axis)
+- pp: GPipe pipeline parallelism (--num-microbatches)
+- moe: Switch-style mixture-of-experts over an 'expert' axis
+  (--num-experts, --capacity-factor)
+
+No reference counterpart (SURVEY.md section 5: long context and every
+non-data parallelism axis are absent there).
 
 Synthetic data is a fixed random Markov chain over the vocabulary (each
 token has a handful of likely successors), so the LM has real structure to
@@ -14,6 +19,9 @@ data/datasets.make_synthetic.
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
       python -m ps_pytorch_tpu.cli.train_lm --num-dp 2 --num-sp 4 \\
       --seq-len 256 --max-steps 20
+  ... --parallelism tp --heads 8
+  ... --parallelism pp --depth 8 --num-microbatches 4
+  ... --parallelism moe --num-experts 8
 """
 
 from __future__ import annotations
@@ -73,18 +81,22 @@ def main(argv=None) -> dict:
     parser.add_argument("--log-interval", type=int, default=10)
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--bidirectional-ring", action="store_true")
+    parser.add_argument("--parallelism", default="dp_sp",
+                        choices=["dp_sp", "tp", "pp", "moe"])
+    parser.add_argument("--sp-attention", default="ring",
+                        choices=["ring", "ulysses"])
+    parser.add_argument("--num-shards", type=int, default=0,
+                        help="tp/pp/moe axis size (0 = all devices)")
+    parser.add_argument("--num-microbatches", type=int, default=2,
+                        help="pp only: microbatches per step")
+    parser.add_argument("--num-experts", type=int, default=8,
+                        help="moe only: total experts")
+    parser.add_argument("--capacity-factor", type=float, default=1.25,
+                        help="moe only: expert capacity factor")
     parser.add_argument("--train-size", type=int, default=512,
                         help="synthetic corpus size (sequences)")
     parser.add_argument("--metrics-file", type=str, default=None)
     args = parser.parse_args(argv)
-
-    n_dev = len(jax.devices())
-    num_sp = args.num_sp or max(n_dev // args.num_dp, 1)
-    mesh = make_mesh_2d(args.num_dp, num_sp)
-    if args.seq_len % num_sp:
-        raise ValueError(f"--seq-len must be divisible by num_sp={num_sp}")
-    if args.batch_size % args.num_dp:
-        raise ValueError(f"--batch-size must be divisible by num_dp={args.num_dp}")
 
     cfg = TransformerConfig(
         vocab_size=args.vocab_size,
@@ -94,20 +106,88 @@ def main(argv=None) -> dict:
         max_seq_len=args.seq_len,
         remat=args.remat,
         bidirectional_ring=args.bidirectional_ring,
+        sp_attention=args.sp_attention,
     )
-    params = init_transformer(cfg, jax.random.key(args.seed))
     tx = build_optimizer("sgd", args.lr, momentum=args.momentum)
-    opt_state = tx.init(params)
-    step = make_lm_train_step(cfg, tx, mesh)
+    n_dev = len(jax.devices())
+    n_shards = args.num_shards or n_dev
+    key = jax.random.key(args.seed)
+
+    # Each scheme yields (params, opt_state, run(params, opt, np_tokens) ->
+    # (params, opt, loss)) over its own mesh; the training loop below is
+    # scheme-agnostic.
+    if args.parallelism == "dp_sp":
+        num_sp = args.num_sp or max(n_dev // args.num_dp, 1)
+        mesh = make_mesh_2d(args.num_dp, num_sp)
+        if args.seq_len % num_sp:
+            raise ValueError(f"--seq-len must be divisible by num_sp={num_sp}")
+        if args.batch_size % args.num_dp:
+            raise ValueError(
+                f"--batch-size must be divisible by num_dp={args.num_dp}"
+            )
+        params = init_transformer(cfg, key)
+        opt_state = tx.init(params)
+        step = make_lm_train_step(cfg, tx, mesh)
+        run = lambda p, o, tok: step(p, o, shard_tokens_2d(jnp.asarray(tok), mesh))
+        layout = f"dp {args.num_dp} x sp {num_sp} ({args.sp_attention})"
+    elif args.parallelism == "tp":
+        from ..parallel.tp import init_tp_state, make_tp_mesh, make_tp_train_step
+
+        mesh = make_tp_mesh(n_shards)
+        params, opt_state = init_tp_state(cfg, tx, key, mesh)
+        step = make_tp_train_step(cfg, tx, mesh)
+        run = lambda p, o, tok: step(p, o, jnp.asarray(tok))
+        layout = f"tp {n_shards}"
+    elif args.parallelism == "pp":
+        from ..parallel.pp import init_pp_state, make_pp_mesh, make_pp_train_step
+
+        if args.batch_size % args.num_microbatches:
+            raise ValueError(
+                f"--batch-size must be divisible by "
+                f"num_microbatches={args.num_microbatches}"
+            )
+        mesh = make_pp_mesh(n_shards)
+        params, opt_state = init_pp_state(cfg, tx, key, mesh)
+        step = make_pp_train_step(
+            cfg, tx, mesh, num_microbatches=args.num_microbatches
+        )
+        run = lambda p, o, tok: step(p, o, jnp.asarray(tok))
+        layout = f"pp {n_shards} x {args.num_microbatches} microbatches"
+    else:  # moe
+        from ..parallel.moe import (
+            MoEConfig,
+            init_moe_state,
+            make_ep_mesh,
+            make_moe_train_step,
+            shard_moe_batch,
+        )
+
+        if args.batch_size % n_shards:
+            raise ValueError(
+                f"--batch-size must be divisible by expert shards={n_shards}"
+            )
+        mesh = make_ep_mesh(n_shards)
+        moe = MoEConfig(
+            num_experts=args.num_experts, capacity_factor=args.capacity_factor
+        )
+        params, opt_state = init_moe_state(cfg, moe, tx, key, mesh)
+        moe_step = make_moe_train_step(cfg, moe, tx, mesh)
+        aux_box = {"aux": float("nan")}  # surfaced in the log/metrics below
+
+        def run(p, o, tok):
+            p, o, loss, aux = moe_step(p, o, shard_moe_batch(jnp.asarray(tok), mesh))
+            aux_box["aux"] = aux
+            return p, o, loss
+
+        layout = f"moe {args.num_experts} experts over {n_shards} shards"
 
     corpus = make_synthetic_tokens(
         args.vocab_size, args.train_size, args.seq_len, seed=args.seed + 1
     )
     n_params = sum(int(np.prod(np.shape(x))) for x in jax.tree_util.tree_leaves(params))
     logger.info(
-        "LM %dx d%d h%d (%d params), seq %d over %d shards, dp %d",
-        args.depth, args.dim, args.heads, n_params,
-        args.seq_len, num_sp, args.num_dp,
+        "LM %dx d%d h%d (%d params), seq %d, %s",
+        args.depth, args.dim, args.heads, n_params, args.seq_len, layout,
     )
 
     rng = np.random.RandomState(args.seed + 2)
@@ -120,8 +200,7 @@ def main(argv=None) -> dict:
             jax.block_until_ready(params)
         t0 = time.perf_counter()
         idx = rng.randint(0, len(corpus), args.batch_size)
-        tokens = shard_tokens_2d(jnp.asarray(corpus[idx]), mesh)
-        params, opt_state, loss = step(params, opt_state, tokens)
+        params, opt_state, loss = run(params, opt_state, corpus[idx])
         if log_now:
             loss = float(loss)  # host sync: dt now spans exactly this step
             dt = time.perf_counter() - t0
@@ -133,11 +212,14 @@ def main(argv=None) -> dict:
                     loss=loss, time_cost=dt, forward=dt,
                 )
             )
-            append_metrics_line(
-                args.metrics_file,
-                {"kind": "train_lm", "step": step_no, "loss": loss,
-                 "time_cost": round(dt, 6)},
-            )
+            record = {"kind": "train_lm", "parallelism": args.parallelism,
+                      "step": step_no, "loss": loss, "time_cost": round(dt, 6)}
+            if args.parallelism == "moe":
+                # router balance: aux == 1 is perfectly balanced; a climb
+                # toward num_experts signals expert collapse
+                record["aux_loss"] = round(float(aux_box["aux"]), 6)
+                logger.info("MoE load-balance aux: %.4f", record["aux_loss"])
+            append_metrics_line(args.metrics_file, record)
     return {"loss": float(loss), "params": n_params}
 
 

@@ -97,6 +97,7 @@ def make_lm_train_step(
     mesh: Mesh,
     dp_axis: str = WORKER_AXIS,
     sp_axis: str = SEQ_AXIS,
+    donate: bool = True,
 ):
     """Jitted 2-D train step: (params, opt_state, tokens) ->
     (params, opt_state, loss). params/opt_state replicated; tokens sharded
@@ -121,4 +122,4 @@ def make_lm_train_step(
         out_specs=(P(), P(), P()),
         check_vma=False,
     )
-    return jax.jit(mapped)
+    return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())

@@ -239,6 +239,7 @@ def make_moe_train_step(
     tx: optax.GradientTransformation,
     mesh: Mesh,
     axis_name: str = EP_AXIS,
+    donate: bool = True,
 ):
     """Jitted MoE LM train step: (params, opt_state, tokens [B, T]) ->
     (params, opt_state, loss, aux). Expert weights + batch sharded over the
@@ -283,7 +284,7 @@ def make_moe_train_step(
         out_specs=(specs_tree, opt_specs, P(), P()),
         check_vma=False,
     )
-    return jax.jit(mapped)
+    return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
 
 
 def _moe_param_shapes(cfg: "TransformerConfig", moe: MoEConfig) -> Dict:

@@ -175,6 +175,7 @@ def make_pp_train_step(
     mesh: Mesh,
     num_microbatches: int,
     axis_name: str = PP_AXIS,
+    donate: bool = True,
 ):
     """Jitted PP LM train step: (params_pp, opt_state, tokens [B, T]) ->
     (params_pp, opt_state, loss). Block params/opt state sharded over the
@@ -215,7 +216,7 @@ def make_pp_train_step(
         out_specs=(specs_tree, opt_specs, P()),
         check_vma=False,
     )
-    return jax.jit(mapped)
+    return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
 
 
 def _pp_param_shapes(cfg: "TransformerConfig") -> Dict:

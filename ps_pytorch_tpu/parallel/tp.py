@@ -248,6 +248,7 @@ def make_tp_train_step(
     tx: optax.GradientTransformation,
     mesh: Mesh,
     axis_name: str = TP_AXIS,
+    donate: bool = True,
 ):
     """Jitted TP LM train step: (params_tp, opt_state, tokens) ->
     (params_tp, opt_state, loss). Params/opt state sharded over the model
@@ -290,4 +291,6 @@ def make_tp_train_step(
         out_specs=(specs_tree, opt_specs, P()),
         check_vma=False,
     )
-    return jax.jit(mapped)
+    # donate params+opt state: the update writes in place in HBM instead of
+    # double-buffering the model (same convention as ps.make_ps_train_step)
+    return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())

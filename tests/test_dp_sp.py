@@ -42,7 +42,8 @@ def test_dp_sp_matches_single_device():
     rng = np.random.RandomState(0)
     tokens = jnp.asarray(rng.randint(0, V, (B, T)), jnp.int32)
 
-    step = make_lm_train_step(CFG, tx, mesh)
+    # donate=False: the oracle below still needs the input buffers
+    step = make_lm_train_step(CFG, tx, mesh, donate=False)
     p2, o2, loss = step(params, opt_state, shard_tokens_2d(tokens, mesh))
 
     p_ref, o_ref, loss_ref = _single_device_reference(params, tokens, tx, opt_state)

@@ -125,7 +125,8 @@ def test_pp_multiple_blocks_per_stage_matches():
     want = float(_oracle_loss(CFG, params, tokens))
     tx = sgd(0.0)
     params_pp = shard_params_pp(CFG, to_pp_layout(CFG, params), mesh4)
-    step = make_pp_train_step(CFG, tx, mesh4, num_microbatches=2)
+    # donate=False: params_pp's shards are inspected after the step
+    step = make_pp_train_step(CFG, tx, mesh4, num_microbatches=2, donate=False)
     _, _, loss = step(params_pp, tx.init(params_pp), tokens)
     assert abs(float(loss) - want) < 2e-5, (float(loss), want)
     assert params_pp["blocks"]["wqkv"].addressable_shards[0].data.shape[0] == 2
