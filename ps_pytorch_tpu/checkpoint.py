@@ -111,6 +111,21 @@ def load_checkpoint(target, model_dir: str, step: int):
     return serialization.from_bytes(target, _read_bytes(model_dir, step))
 
 
+def restore_sharded(target, model_dir: str, step: int, mesh, specs):
+    """Load step N and place every leaf on `mesh` with its PartitionSpec
+    from `specs` (a pytree of PartitionSpecs, e.g. parallel.tp_param_specs
+    output or an opt_state_specs tree).
+
+    save_checkpoint gathers sharded arrays to full host arrays
+    (jax.device_get), so a checkpoint written from a tp/pp/moe-sharded
+    state restores onto ANY mesh shape whose specs divide the shapes —
+    resharding across different device counts is free.
+    """
+    from .parallel.mesh import place_on_mesh
+
+    return place_on_mesh(load_checkpoint(target, model_dir, step), mesh, specs)
+
+
 def load_checkpoint_raw(model_dir: str, step: int) -> dict:
     """Load step N as raw nested dicts, no target structure required.
 

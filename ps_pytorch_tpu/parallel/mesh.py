@@ -38,6 +38,22 @@ def make_mesh(
     return Mesh(np.array(devs[:n]), (axis_name,))
 
 
+def place_on_mesh(tree, mesh: Mesh, specs):
+    """Place every leaf of `tree` on `mesh` with its PartitionSpec from
+    `specs` (a matching pytree of PartitionSpecs). None leaves (e.g. a
+    momentum-free optimizer's buffer slot) pass through untouched.
+
+    The single implementation behind shard_params_{tp,pp,moe},
+    init_{tp,pp,moe}_state, and checkpoint.restore_sharded.
+    """
+    return jax.tree_util.tree_map(
+        lambda x, s: None if x is None else jax.device_put(x, NamedSharding(mesh, s)),
+        tree,
+        specs,
+        is_leaf=lambda x: x is None,
+    )
+
+
 def batch_sharding(mesh: Mesh, axis_name: str = WORKER_AXIS) -> NamedSharding:
     """Sharding for a global batch: split along the leading (batch) dim."""
     return NamedSharding(mesh, P(axis_name))

@@ -118,13 +118,9 @@ def shard_params_moe(
     e = params["blocks"][0]["w_up_e"].shape[0]
     if e % n:
         raise ValueError(f"{e} experts not divisible by {n} expert shards")
-    specs = moe_param_specs(cfg, axis)
-    return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params,
-        specs,
-        is_leaf=lambda x: isinstance(x, P),
-    )
+    from .mesh import place_on_mesh
+
+    return place_on_mesh(params, mesh, moe_param_specs(cfg, axis))
 
 
 def _gate_and_dispatch(x2d, wg, capacity):
@@ -305,15 +301,11 @@ def init_moe_state(
     params = shard_params_moe(
         cfg, init_moe_params(cfg, moe, key), mesh, axis_name
     )
+    from .mesh import place_on_mesh
+
     opt_state = tx.init(params)
     specs = opt_state_specs(opt_state, params, moe_param_specs(cfg, axis_name))
-    opt_state = jax.tree.map(
-        lambda x, s: None if x is None else jax.device_put(x, NamedSharding(mesh, s)),
-        opt_state,
-        specs,
-        is_leaf=lambda x: x is None,
-    )
-    return params, opt_state
+    return params, place_on_mesh(opt_state, mesh, specs)
 
 
 def shard_moe_batch(tokens, mesh: Mesh, axis_name: str = EP_AXIS):

@@ -94,13 +94,9 @@ def shard_params_pp(
     n = mesh.shape[axis]
     if cfg.depth % n:
         raise ValueError(f"depth {cfg.depth} not divisible by {n} stages")
-    specs = pp_param_specs(cfg, axis)
-    return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params_pp,
-        specs,
-        is_leaf=lambda x: isinstance(x, P),
-    )
+    from .mesh import place_on_mesh
+
+    return place_on_mesh(params_pp, mesh, pp_param_specs(cfg, axis))
 
 
 def _block(cfg: "TransformerConfig", x, blk):
@@ -239,12 +235,8 @@ def init_pp_state(
     params_pp = shard_params_pp(
         cfg, to_pp_layout(cfg, init_transformer(cfg, key)), mesh, axis_name
     )
+    from .mesh import place_on_mesh
+
     opt_state = tx.init(params_pp)
     specs = opt_state_specs(opt_state, params_pp, pp_param_specs(cfg, axis_name))
-    opt_state = jax.tree.map(
-        lambda x, s: None if x is None else jax.device_put(x, NamedSharding(mesh, s)),
-        opt_state,
-        specs,
-        is_leaf=lambda x: x is None,
-    )
-    return params_pp, opt_state
+    return params_pp, place_on_mesh(opt_state, mesh, specs)

@@ -119,13 +119,9 @@ def shard_params_tp(
         raise ValueError(
             f"mlp dim {cfg.dim * cfg.mlp_ratio} not divisible by {n} model shards"
         )
-    specs = tp_param_specs(cfg, axis)
-    return jax.tree.map(
-        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)),
-        params_tp,
-        specs,
-        is_leaf=lambda x: isinstance(x, P),
-    )
+    from .mesh import place_on_mesh
+
+    return place_on_mesh(params_tp, mesh, tp_param_specs(cfg, axis))
 
 
 def apply_transformer_tp(
@@ -232,15 +228,11 @@ def init_tp_state(
     params_tp = shard_params_tp(
         cfg, to_tp_layout(cfg, init_transformer(cfg, key)), mesh, axis_name
     )
+    from .mesh import place_on_mesh
+
     opt_state = tx.init(params_tp)
     specs = opt_state_specs(opt_state, params_tp, tp_param_specs(cfg, axis_name))
-    opt_state = jax.tree.map(
-        lambda x, s: None if x is None else jax.device_put(x, NamedSharding(mesh, s)),
-        opt_state,
-        specs,
-        is_leaf=lambda x: x is None,
-    )
-    return params_tp, opt_state
+    return params_tp, place_on_mesh(opt_state, mesh, specs)
 
 
 def make_tp_train_step(
