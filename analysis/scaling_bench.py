@@ -59,13 +59,15 @@ def bench_mesh(network, dataset, num_workers, per_worker_batch, steps, compress)
     }
     sharded = shard_batch(batch, mesh, cfg)
     key = jax.random.key(1)
+    from ps_pytorch_tpu.utils import host_sync
+
     for _ in range(2):  # compile + settle
         state, m = step(state, sharded, key)
-    jax.block_until_ready(state.params)
+    host_sync(state.params, m)  # HOST read barrier — see utils/sync.py
     t0 = time.perf_counter()
     for _ in range(steps):
         state, m = step(state, sharded, key)
-    jax.block_until_ready(state.params)
+    host_sync(state.params, m)  # params chain: serializes the whole window
     dt = time.perf_counter() - t0
     return {
         "workers": num_workers,

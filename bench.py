@@ -88,10 +88,17 @@ def main() -> None:
     sharded = shard_batch(batch, mesh, cfg)
     key = jax.random.key(1)
 
-    # warmup: compile + one steady-state step
+    from ps_pytorch_tpu.utils import host_sync
+
+    # warmup: compile + one steady-state step. Sync via HOST reads
+    # (utils/sync.py), not jax.block_until_ready: on the tunneled
+    # single-chip platform block_until_ready can return before the
+    # computation retires, silently turning the benchmark into a
+    # dispatch-rate measurement — and the loss alone does not serialize
+    # the optimizer update, which feeds only the params outputs.
     for _ in range(2):
         state, metrics = step(state, sharded, key)
-    jax.block_until_ready(state.params)
+    host_sync(state.params, metrics)
 
     # BENCH_STEPS trims the measured window for smoke runs on slow hosts;
     # throughput extrapolates, the baseline comparison stays per-image.
@@ -99,11 +106,13 @@ def main() -> None:
     t0 = time.perf_counter()
     for _ in range(steps):
         state, metrics = step(state, sharded, key)
-    jax.block_until_ready(state.params)
+    # params chain step-to-step, so this host read serializes the whole
+    # measured window (forward, backward, collectives, AND update)
+    host_sync(state.params, metrics)
     elapsed = time.perf_counter() - t0
+    loss = float(metrics["loss"])
 
     images_per_sec = steps * w["batch"] / elapsed
-    loss = float(metrics["loss"])
     assert np.isfinite(loss), f"non-finite loss {loss}"
     print(
         json.dumps(

@@ -37,7 +37,7 @@ from ..models.transformer import TransformerConfig, init_transformer
 from ..optim import build_optimizer
 from ..parallel.dp_sp import make_lm_train_step, make_mesh_2d, shard_tokens_2d
 from ..trainer import append_metrics_line
-from ..utils import format_iter_line, get_logger
+from ..utils import format_iter_line, get_logger, host_sync
 
 logger = get_logger()
 
@@ -197,12 +197,14 @@ def main(argv=None) -> dict:
         if log_now:
             # drain the async-dispatch backlog BEFORE starting the clock so
             # dt measures ONE step, not the queue of unlogged steps
-            jax.block_until_ready(params)
+            # (host-read barrier — block_until_ready can lie, utils/sync.py)
+            host_sync(params)
         t0 = time.perf_counter()
         idx = rng.randint(0, len(corpus), args.batch_size)
         params, opt_state, loss = run(params, opt_state, corpus[idx])
         if log_now:
-            loss = float(loss)  # host sync: dt now spans exactly this step
+            loss = float(loss)
+            host_sync(params)  # include the param update in dt
             dt = time.perf_counter() - t0
             logger.info(
                 format_iter_line(
