@@ -82,7 +82,7 @@ def main(argv=None) -> dict:
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--bidirectional-ring", action="store_true")
     parser.add_argument("--parallelism", default="dp_sp",
-                        choices=["dp_sp", "tp", "pp", "moe"])
+                        choices=["dp_sp", "dp_tp", "tp", "pp", "moe"])
     parser.add_argument("--sp-attention", default="ring",
                         choices=["ring", "ulysses"])
     parser.add_argument("--num-shards", type=int, default=0,
@@ -138,6 +138,24 @@ def main(argv=None) -> dict:
         step = make_tp_train_step(cfg, tx, mesh)
         run = lambda p, o, tok: step(p, o, jnp.asarray(tok))
         layout = f"tp {n_shards}"
+    elif args.parallelism == "dp_tp":
+        from ..parallel.dp_tp import (
+            init_dp_tp_state,
+            make_dp_tp_train_step,
+            make_mesh_dp_tp,
+            shard_tokens_dp,
+        )
+
+        num_tp = args.num_shards or max(n_dev // args.num_dp, 1)
+        if args.batch_size % args.num_dp:
+            raise ValueError(
+                f"--batch-size must be divisible by num_dp={args.num_dp}"
+            )
+        mesh = make_mesh_dp_tp(args.num_dp, num_tp)
+        params, opt_state = init_dp_tp_state(cfg, tx, key, mesh)
+        step = make_dp_tp_train_step(cfg, tx, mesh)
+        run = lambda p, o, tok: step(p, o, shard_tokens_dp(jnp.asarray(tok), mesh))
+        layout = f"dp {args.num_dp} x tp {num_tp}"
     elif args.parallelism == "pp":
         from ..parallel.pp import init_pp_state, make_pp_mesh, make_pp_train_step
 

@@ -25,6 +25,12 @@ from .ring_attention import (
     ring_attention,
     shard_sequence,
 )
+from .dp_tp import (
+    init_dp_tp_state,
+    make_dp_tp_train_step,
+    make_mesh_dp_tp,
+    shard_tokens_dp,
+)
 from .moe import (
     EP_AXIS,
     MoEConfig,

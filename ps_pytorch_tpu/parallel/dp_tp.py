@@ -1,0 +1,128 @@
+"""2-D parallelism: data parallelism x Megatron tensor parallelism.
+
+The standard LM scaling layout: batch shards ride the outer `workers`
+axis (the PS data-parallel axis), each data shard's model is split over
+the inner `model` axis (parallel/tp.py), which stays on the
+highest-bandwidth ICI dimension where the per-block psums live. Params
+are TP-sharded over `model` and replicated over `workers` — exactly the
+PS engine's replication contract, so the PS semantics (mesh.py docstring)
+extend unchanged to a tensor-sharded model.
+
+Gradient math (the shard_map sum-over-shards AD rule, see tp.py): every
+(dp, tp) device outputs its dp-row's loss L_i, so the traced global
+function sums to n_tp * sum_i L_i. Differentiating loss/(n_tp * n_dp)
+makes each device's gradient (1/n_dp) dL_i/dtheta; one psum over `workers`
+for TP-sharded leaves (their copies are replicated across dp rows) and
+one psum over BOTH axes for replicated leaves (their grads are also
+partial across tp) recovers the exact gradient of the global batch-mean
+loss. Verified one-step-exact against single-device training in
+tests/test_dp_tp.py.
+"""
+
+from __future__ import annotations
+
+from typing import Optional, Sequence
+
+import jax
+import optax
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ..ops.metrics import next_token_nll
+from .mesh import WORKER_AXIS, batch_sharding, place_on_mesh
+from .tp import (
+    TP_AXIS,
+    _tp_param_shapes,
+    apply_transformer_tp,
+    opt_state_specs,
+    shard_params_tp,
+    to_tp_layout,
+    tp_param_specs,
+)
+
+
+def make_mesh_dp_tp(
+    num_dp: int,
+    num_tp: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+    dp_axis: str = WORKER_AXIS,
+    tp_axis: str = TP_AXIS,
+) -> Mesh:
+    """(num_dp x num_tp) mesh; tp inner so the per-block psums stay on
+    neighboring devices. Same grid builder as dp x sp, different inner
+    axis."""
+    from .dp_sp import make_mesh_2d
+
+    return make_mesh_2d(
+        num_dp, num_tp, devices=devices, dp_axis=dp_axis, sp_axis=tp_axis
+    )
+
+
+def init_dp_tp_state(cfg, tx, key, mesh, tp_axis: str = TP_AXIS):
+    """Init (params_tp, opt_state): TP-sharded over `model`, replicated
+    over `workers` (the specs name only the tp axis; dp replication is
+    implicit)."""
+    from ..models.transformer import init_transformer
+
+    # shard_params_tp validates heads/mlp divisibility by the tp axis size
+    params = shard_params_tp(
+        cfg, to_tp_layout(cfg, init_transformer(cfg, key)), mesh, tp_axis
+    )
+    opt_state = tx.init(params)
+    return params, place_on_mesh(
+        opt_state, mesh, opt_state_specs(opt_state, params, tp_param_specs(cfg, tp_axis))
+    )
+
+
+def shard_tokens_dp(tokens, mesh: Mesh, dp_axis: str = WORKER_AXIS):
+    """[B_global, T] -> B sharded over dp, replicated over tp."""
+    return jax.device_put(tokens, batch_sharding(mesh, dp_axis))
+
+
+def make_dp_tp_train_step(
+    cfg,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    dp_axis: str = WORKER_AXIS,
+    tp_axis: str = TP_AXIS,
+    donate: bool = True,
+):
+    """Jitted 2-D train step: (params_tp, opt_state, tokens) ->
+    (params_tp, opt_state, loss). tokens sharded [B over dp]; loss is the
+    global batch mean."""
+    specs_tree = tp_param_specs(cfg, tp_axis)
+
+    def shard_fn(params, opt_state, tokens):
+        n_tp = lax.axis_size(tp_axis)
+        n_dp = lax.axis_size(dp_axis)
+
+        def loss_fn(p):
+            logits = apply_transformer_tp(cfg, p, tokens, tp_axis)
+            # scale per the module-docstring gradient math
+            return next_token_nll(logits, tokens) / (n_tp * n_dp)
+
+        loss, grads = jax.value_and_grad(loss_fn)(params)
+        grads = jax.tree.map(
+            lambda g, s: lax.psum(g, (dp_axis, tp_axis))
+            if s == P()
+            else lax.psum(g, dp_axis),
+            grads,
+            specs_tree,
+            is_leaf=lambda x: isinstance(x, P),
+        )
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        # loss was pre-scaled by 1/(n_tp*n_dp); psum over dp recovers the
+        # global batch mean (identical across tp already)
+        return new_params, new_opt, lax.psum(loss, dp_axis) * n_tp
+
+    shapes = _tp_param_shapes(cfg)
+    opt_specs = opt_state_specs(jax.eval_shape(tx.init, shapes), shapes, specs_tree)
+    mapped = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(specs_tree, opt_specs, P(dp_axis)),
+        out_specs=(specs_tree, opt_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())

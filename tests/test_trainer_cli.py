@@ -316,9 +316,10 @@ def test_cli_train_lm_learns_markov_structure(tmp_path):
         ["--parallelism", "tp", "--heads", "8"],
         ["--parallelism", "pp", "--depth", "8", "--num-microbatches", "4"],
         ["--parallelism", "moe", "--num-experts", "8"],
+        ["--parallelism", "dp_tp", "--num-dp", "2", "--heads", "4"],
         ["--sp-attention", "ulysses", "--num-dp", "2", "--heads", "8"],
     ],
-    ids=["tp", "pp", "moe", "ulysses"],
+    ids=["tp", "pp", "moe", "dp_tp", "ulysses"],
 )
 def test_cli_train_lm_parallelism_modes(extra):
     """Every --parallelism scheme trains through the same CLI loop."""
