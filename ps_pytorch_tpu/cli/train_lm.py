@@ -85,6 +85,9 @@ def main(argv=None) -> dict:
                         choices=["dp_sp", "dp_tp", "tp", "pp", "moe"])
     parser.add_argument("--sp-attention", default="ring",
                         choices=["ring", "ulysses"])
+    parser.add_argument("--attention-impl", default="naive",
+                        choices=["naive", "flash"],
+                        help="within-chip attention kernel (flash = Pallas)")
     parser.add_argument("--num-shards", type=int, default=0,
                         help="tp/pp/moe axis size (0 = all devices)")
     parser.add_argument("--num-microbatches", type=int, default=2,
@@ -98,6 +101,12 @@ def main(argv=None) -> dict:
     parser.add_argument("--metrics-file", type=str, default=None)
     args = parser.parse_args(argv)
 
+    if args.attention_impl == "flash" and args.parallelism == "dp_sp":
+        raise ValueError(
+            "--attention-impl flash applies to the within-chip attention of "
+            "the tp/pp/moe paths; --parallelism dp_sp attends via "
+            "--sp-attention (ring/ulysses) and would silently ignore it"
+        )
     cfg = TransformerConfig(
         vocab_size=args.vocab_size,
         dim=args.dim,
@@ -107,6 +116,7 @@ def main(argv=None) -> dict:
         remat=args.remat,
         bidirectional_ring=args.bidirectional_ring,
         sp_attention=args.sp_attention,
+        attention_impl=args.attention_impl,
     )
     tx = build_optimizer("sgd", args.lr, momentum=args.momentum)
     n_dev = len(jax.devices())

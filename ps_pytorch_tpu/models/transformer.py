@@ -47,6 +47,11 @@ class TransformerConfig:
     # count) or "ulysses" (two all_to_alls, heads % axis_size == 0) — see
     # parallel/ulysses.py for the trade-off
     sp_attention: str = "ring"
+    # within-chip attention: "naive" (materializes [T, T]) or "flash"
+    # (Pallas blockwise kernel, ops/flash_attention.py). Applies to the
+    # single-device, tp, pp, and moe paths; the sp paths communicate via
+    # ring/ulysses and keep their own per-block math
+    attention_impl: str = "naive"
 
     @property
     def head_dim(self) -> int:
@@ -91,6 +96,19 @@ def init_transformer(cfg: TransformerConfig, key: jax.Array) -> Dict:
 def _rms_norm(x, gamma, eps=1e-6):
     var = jnp.mean(jnp.square(x), axis=-1, keepdims=True)
     return x * jax.lax.rsqrt(var + eps) * gamma
+
+
+def local_attention(cfg: TransformerConfig):
+    """The within-chip attention callable for this config: the Pallas
+    flash kernel or the naive jnp reference. Shared by the single-device,
+    tensor-, pipeline-, and expert-parallel paths."""
+    if cfg.attention_impl == "flash":
+        from ..ops.flash_attention import flash_attention
+
+        return partial(flash_attention, causal=cfg.causal)
+    if cfg.attention_impl == "naive":
+        return partial(full_attention, causal=cfg.causal)
+    raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
 
 
 def transformer_block(cfg: TransformerConfig, x, blk, attend, mlp=None):
@@ -149,7 +167,7 @@ def apply_transformer(
             raise ValueError(f"unknown sp_attention {cfg.sp_attention!r}")
     else:
         shard = 0
-        attend = partial(full_attention, causal=cfg.causal)
+        attend = local_attention(cfg)
     if pos_offset is not None:
         shard = shard + pos_offset
     pos = shard + jnp.arange(t_loc)

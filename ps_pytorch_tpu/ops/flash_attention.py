@@ -1,0 +1,332 @@
+"""Flash attention as Pallas TPU kernels (forward + backward).
+
+The single-chip attention hot path. parallel/ring_attention.py and
+parallel/ulysses.py already avoid materializing the [T, T] score matrix
+ACROSS chips; this kernel does the same WITHIN a chip: blockwise online
+softmax in VMEM, O(T) memory instead of O(T^2) HBM traffic, MXU-shaped
+[block_q, d] x [d, block_k] matmuls.
+
+Layout: inputs [B, T, H, D] are folded to [B*H, T, D]; the grid walks
+(batch*head, q_block, k_block) with the k axis innermost, accumulating
+(acc, row-max m, row-sum l) in VMEM scratch and writing the normalized
+output plus the logsumexp L = m + log(l) at the last k step. The backward
+pass recomputes p = exp(q k^T * scale - L) per block (flash-attention-2
+style) in two kernels: one accumulating dq over k blocks, one accumulating
+(dk, dv) over q blocks, seeded with delta = rowsum(do * o) computed in
+plain XLA.
+
+Causality is enforced by masking with global positions (uniform grid —
+fully-masked blocks still run; the win is memory, not skipped FLOPs).
+
+Selection follows ops/quantize.py's convention: Pallas on TPU backends,
+interpret mode under PS_TPU_PALLAS_INTERPRET=1 (how CPU CI exercises the
+kernels), pure-jnp reference otherwise (PS_TPU_DISABLE_PALLAS=1 forces
+it). The jnp reference is ring_attention.full_attention — also the test
+oracle.
+"""
+
+from __future__ import annotations
+
+import os
+from functools import partial
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+NEG_INF = -1e30
+
+
+def _pallas_mode() -> Optional[dict]:
+    if os.environ.get("PS_TPU_DISABLE_PALLAS"):
+        return None
+    if os.environ.get("PS_TPU_PALLAS_INTERPRET"):
+        return {"interpret": True}
+    if jax.default_backend() == "tpu":
+        return {}
+    return None
+
+
+# --------------------------------------------------------------- forward
+
+
+def _make_fwd_kernel(scale, causal, block_q, block_k, n_k):
+    from jax.experimental import pallas as pl
+
+    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref):
+        qi = pl.program_id(1)
+        ki = pl.program_id(2)
+
+        @pl.when(ki == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+            m_ref[:] = jnp.full_like(m_ref, NEG_INF)
+            l_ref[:] = jnp.zeros_like(l_ref)
+
+        q = q_ref[0]  # [Bq, D]
+        k = k_ref[0]  # [Bk, D]
+        v = v_ref[0]  # [Bk, D]
+        scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            scores = jnp.where(k_pos <= q_pos, scores, NEG_INF)
+
+        m_prev = m_ref[:]  # [Bq, 1]
+        m_blk = jnp.max(scores, axis=-1, keepdims=True)
+        m_new = jnp.maximum(m_prev, m_blk)
+        p = jnp.exp(scores - m_new)  # [Bq, Bk]
+        if causal:
+            # rows with every key masked: m_new == NEG_INF, exp(0)=1 junk
+            p = jnp.where(m_new > NEG_INF / 2, p, 0.0)
+        alpha = jnp.exp(m_prev - m_new)  # [Bq, 1]
+        l_ref[:] = l_ref[:] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        acc_ref[:] = acc_ref[:] * alpha + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_ref[:] = m_new
+
+        @pl.when(ki == n_k - 1)
+        def _finalize():
+            l = l_ref[:]
+            l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0
+            o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+            lse_ref[0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
+
+    return kernel
+
+
+def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, mode):
+    """q3/k3/v3: [BH, T, D] f32 -> (o [BH, T, D], lse [BH, T])."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t, d = q3.shape
+    n_q, n_k = t // block_q, t // block_k
+    kernel = _make_fwd_kernel(scale, causal, block_q, block_k, n_k)
+    return pl.pallas_call(
+        kernel,
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda b, qi, ki: (b, qi)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_q, d), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+            pltpu.VMEM((block_q, 1), jnp.float32),
+        ],
+        **mode,
+    )(q3, k3, v3)
+
+
+# --------------------------------------------------------------- backward
+
+
+def _make_dq_kernel(scale, causal, block_q, block_k, n_k):
+    from jax.experimental import pallas as pl
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref):
+        qi = pl.program_id(1)
+        ki = pl.program_id(2)
+
+        @pl.when(ki == 0)
+        def _init():
+            acc_ref[:] = jnp.zeros_like(acc_ref)
+
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0][:, None]  # [Bq, 1]
+        delta = delta_ref[0][:, None]  # [Bq, 1]
+        scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            scores = jnp.where(k_pos <= q_pos, scores, NEG_INF)
+        p = jnp.exp(scores - lse)  # exact softmax probs, [Bq, Bk]
+        # fully-masked rows: lse == NEG_INF and scores == NEG_INF give
+        # exp(0) = 1; such rows contributed nothing forward, so zero them
+        p = jnp.where(lse > NEG_INF / 2, p, 0.0)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale
+        acc_ref[:] += jnp.dot(ds, k, preferred_element_type=jnp.float32)
+
+        @pl.when(ki == n_k - 1)
+        def _finalize():
+            dq_ref[0] = acc_ref[:].astype(dq_ref.dtype)
+
+    return kernel
+
+
+def _make_dkv_kernel(scale, causal, block_q, block_k, n_q):
+    from jax.experimental import pallas as pl
+
+    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dk_ref, dv_ref, dk_acc, dv_acc):
+        ki = pl.program_id(1)
+        qi = pl.program_id(2)
+
+        @pl.when(qi == 0)
+        def _init():
+            dk_acc[:] = jnp.zeros_like(dk_acc)
+            dv_acc[:] = jnp.zeros_like(dv_acc)
+
+        q, k, v, do = q_ref[0], k_ref[0], v_ref[0], do_ref[0]
+        lse = lse_ref[0][:, None]
+        delta = delta_ref[0][:, None]
+        scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
+        if causal:
+            q_pos = qi * block_q + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 0
+            )
+            k_pos = ki * block_k + jax.lax.broadcasted_iota(
+                jnp.int32, (block_q, block_k), 1
+            )
+            scores = jnp.where(k_pos <= q_pos, scores, NEG_INF)
+        p = jnp.exp(scores - lse)  # [Bq, Bk]
+        p = jnp.where(lse > NEG_INF / 2, p, 0.0)  # fully-masked rows (see dq)
+        dv_acc[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
+        dp = jnp.dot(do, v.T, preferred_element_type=jnp.float32)
+        ds = p * (dp - delta) * scale  # [Bq, Bk]
+        dk_acc[:] += jnp.dot(ds.T, q, preferred_element_type=jnp.float32)
+
+        @pl.when(qi == n_q - 1)
+        def _finalize():
+            dk_ref[0] = dk_acc[:].astype(dk_ref.dtype)
+            dv_ref[0] = dv_acc[:].astype(dv_ref.dtype)
+
+    return kernel
+
+
+def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k, mode):
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    bh, t, d = q3.shape
+    n_q, n_k = t // block_q, t // block_k
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
+
+    dq = pl.pallas_call(
+        _make_dq_kernel(scale, causal, block_q, block_k, n_k),
+        grid=(bh, n_q, n_k),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda b, qi, ki: (b, qi)),
+            pl.BlockSpec((1, block_q), lambda b, qi, ki: (b, qi)),
+        ],
+        out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+        scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
+        **mode,
+    )(q3, k3, v3, do3, lse, delta)
+
+    dk, dv = pl.pallas_call(
+        _make_dkv_kernel(scale, causal, block_q, block_k, n_q),
+        grid=(bh, n_k, n_q),
+        in_specs=[
+            pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda b, ki, qi: (b, qi)),
+            pl.BlockSpec((1, block_q), lambda b, ki, qi: (b, qi)),
+        ],
+        out_specs=[
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+            pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
+        ],
+        out_shape=[
+            jax.ShapeDtypeStruct((bh, t, d), k3.dtype),
+            jax.ShapeDtypeStruct((bh, t, d), v3.dtype),
+        ],
+        scratch_shapes=[
+            pltpu.VMEM((block_k, d), jnp.float32),
+            pltpu.VMEM((block_k, d), jnp.float32),
+        ],
+        **mode,
+    )(q3, k3, v3, do3, lse, delta)
+    return dq, dk, dv
+
+
+# --------------------------------------------------------------- public API
+
+
+def _pick_block(t: int, want: int) -> int:
+    b = min(want, t)
+    while t % b:
+        b //= 2
+    return max(b, 1)
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6))
+def _flash(q3, k3, v3, scale, causal, block_q, block_k):
+    o, _ = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k,
+                      _pallas_mode() or {"interpret": True})
+    return o
+
+
+def _flash_vjp_fwd(q3, k3, v3, scale, causal, block_q, block_k):
+    mode = _pallas_mode() or {"interpret": True}
+    o, lse = _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, mode)
+    return o, (q3, k3, v3, o, lse)
+
+
+def _flash_vjp_bwd(scale, causal, block_q, block_k, res, do3):
+    q3, k3, v3, o3, lse = res
+    mode = _pallas_mode() or {"interpret": True}
+    return _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal,
+                      block_q, block_k, mode)
+
+
+_flash.defvjp(_flash_vjp_fwd, _flash_vjp_bwd)
+
+
+def flash_attention(
+    q: jax.Array,  # [B, T, H, D]
+    k: jax.Array,
+    v: jax.Array,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """Drop-in replacement for ring_attention.full_attention ([B, T, H, D]
+    in and out), differentiable, Pallas-backed on TPU.
+
+    Falls back to the jnp reference when Pallas is unavailable/disabled.
+    T must be divisible by the (auto-shrunk) block sizes.
+    """
+    if _pallas_mode() is None:
+        from ..parallel.ring_attention import full_attention
+
+        return full_attention(q, k, v, causal=causal, scale=scale)
+
+    b, t, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    bq = _pick_block(t, block_q)
+    bk = _pick_block(t, block_k)
+    fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+    o3 = _flash(fold(q), fold(k), fold(v), float(scale), bool(causal), bq, bk)
+    return o3.reshape(b, h, t, d).transpose(0, 2, 1, 3)

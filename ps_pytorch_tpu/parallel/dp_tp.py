@@ -32,6 +32,7 @@ from ..ops.metrics import next_token_nll
 from .mesh import WORKER_AXIS, batch_sharding, place_on_mesh
 from .tp import (
     TP_AXIS,
+    _is_replicated,
     _tp_param_shapes,
     apply_transformer_tp,
     opt_state_specs,
@@ -104,7 +105,7 @@ def make_dp_tp_train_step(
         loss, grads = jax.value_and_grad(loss_fn)(params)
         grads = jax.tree.map(
             lambda g, s: lax.psum(g, (dp_axis, tp_axis))
-            if s == P()
+            if _is_replicated(s)
             else lax.psum(g, dp_axis),
             grads,
             specs_tree,

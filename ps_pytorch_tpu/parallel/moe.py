@@ -29,7 +29,6 @@ all_to_all, which is exact under this convention).
 from __future__ import annotations
 
 import dataclasses
-from functools import partial
 from typing import Dict, Optional, Sequence, TYPE_CHECKING
 
 import jax
@@ -195,13 +194,12 @@ def apply_moe_transformer(
     axis_name: Optional[str] = None,
 ) -> tuple:
     """Forward -> (logits [B_local, T, vocab], mean aux loss)."""
-    from ..models.transformer import _rms_norm, transformer_block
-    from .ring_attention import full_attention
+    from ..models.transformer import _rms_norm, local_attention, transformer_block
 
     b, t = tokens.shape
     pos = jnp.arange(t)
     x = params["embed"][tokens] + params["pos_embed"][pos][None]
-    attend = partial(full_attention, causal=cfg.causal)
+    attend = local_attention(cfg)
 
     def block_fn(x, blk):
         # transformer_block calls mlp(h) exactly once; the cell carries the

@@ -101,11 +101,9 @@ def shard_params_pp(
 
 def _block(cfg: "TransformerConfig", x, blk):
     """One transformer block — the same function the oracle runs."""
-    from ..models.transformer import transformer_block
-    from .ring_attention import full_attention
+    from ..models.transformer import local_attention, transformer_block
 
-    attend = partial(full_attention, causal=cfg.causal)
-    return transformer_block(cfg, x, blk, attend)
+    return transformer_block(cfg, x, blk, local_attention(cfg))
 
 
 def _pp_logits_and_loss(

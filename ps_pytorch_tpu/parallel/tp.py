@@ -35,7 +35,6 @@ from jax import lax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..ops.metrics import next_token_nll
-from .ring_attention import full_attention
 
 # NOTE: ..models.transformer imports from this package (ring_attention), so
 # importing it at module top would be circular; TransformerConfig appears
@@ -137,8 +136,9 @@ def apply_transformer_tp(
     result is bit-identical (up to reduction order) to the single-device
     model.
     """
-    from ..models.transformer import _rms_norm
+    from ..models.transformer import _rms_norm, local_attention
 
+    attend_local = local_attention(cfg)
     b, t = tokens.shape
     pos = jnp.arange(t)
     x = params["embed"][tokens] + params["pos_embed"][pos][None]
@@ -147,7 +147,7 @@ def apply_transformer_tp(
         h = _rms_norm(x, blk["ln1"])
         qkv = jnp.einsum("btd,dchk->btchk", h, blk["wqkv"])  # [B,T,3,Hloc,hd]
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
-        o = full_attention(q, k, v, causal=cfg.causal)  # local heads only
+        o = attend_local(q, k, v)  # local heads only
         proj = jnp.einsum("bthk,hkd->btd", o, blk["wo"])
         x = x + lax.psum(proj, axis_name)
         h = _rms_norm(x, blk["ln2"])
