@@ -78,8 +78,47 @@ def bench_mesh(network, dataset, num_workers, per_worker_batch, steps, compress)
     }
 
 
+def bench_lm_mesh(parallelism, num_shards, batch, seq_len, steps, lm_kw):
+    """Tokens/sec for one LM parallelism scheme at one axis size, through
+    the same CLI machinery users run (cli/train_lm adapters)."""
+    from ps_pytorch_tpu.cli.train_lm import main as lm_main
+
+    # dp_sp sizes its sequence axis from --num-sp; every other scheme
+    # reads --num-shards (passing the wrong one would silently rerun the
+    # same configuration at every sweep point)
+    axis_flag = "--num-sp" if parallelism == "dp_sp" else "--num-shards"
+    t0 = time.perf_counter()
+    out = lm_main(
+        [
+            "--parallelism", parallelism,
+            axis_flag, str(num_shards),
+            "--num-dp", str(lm_kw.get("num_dp", 1)),
+            "--batch-size", str(batch),
+            "--seq-len", str(seq_len),
+            "--max-steps", str(steps + 2),
+            "--log-interval", str(steps + 2),
+            "--dim", str(lm_kw.get("dim", 128)),
+            "--depth", str(lm_kw.get("depth", 2)),
+            "--heads", str(lm_kw.get("heads", 8)),
+        ]
+    )
+    dt = time.perf_counter() - t0
+    return {
+        "parallelism": parallelism,
+        "shards": num_shards,
+        "batch": batch,
+        "seq_len": seq_len,
+        # end-to-end wall including the first-step compile — raise --steps
+        # on real hardware to amortize it (the ps workload excludes compile)
+        "tokens_per_sec": round(batch * seq_len * (steps + 2) / dt, 1),
+        "final_loss": round(out["loss"], 4),
+    }
+
+
 def main(argv=None):
     p = argparse.ArgumentParser("analysis.scaling_bench")
+    p.add_argument("--workload", default="ps", choices=["ps", "lm"],
+                   help="ps: CNN PS data path; lm: transformer axes")
     p.add_argument("--network", default="LeNet")
     p.add_argument("--dataset", default="MNIST")
     p.add_argument("--batch-size", type=int, default=1024,
@@ -90,6 +129,10 @@ def main(argv=None):
                    help="fixed global batch divided across workers")
     p.add_argument("--compress", action="store_true",
                    help="int8-quantized gradient collectives")
+    p.add_argument("--parallelism", default="tp",
+                   choices=["dp_sp", "dp_tp", "tp", "pp", "moe"],
+                   help="lm workload: scheme to scale over --workers")
+    p.add_argument("--seq-len", type=int, default=256)
     p.add_argument("--json", default=None, help="also write results to this file")
     args = p.parse_args(argv)
 
@@ -97,18 +140,29 @@ def main(argv=None):
 
     rows = []
     for w in args.workers:
-        pw = args.batch_size // w if args.strong else args.batch_size
-        rows.append(
-            bench_mesh(args.network, args.dataset, w, pw, args.steps, args.compress)
-        )
+        if args.workload == "lm":
+            # batch = shards * (even k): divisible by the expert axis, by
+            # num_dp=1, and by the default 2 pp microbatches at every w
+            batch = w * max(2 * (args.batch_size // 512), 2)
+            rows.append(
+                bench_lm_mesh(
+                    args.parallelism, w, batch, args.seq_len,
+                    args.steps, {"heads": 8, "depth": 2 if args.parallelism != "pp" else 8},
+                )
+            )
+        else:
+            pw = args.batch_size // w if args.strong else args.batch_size
+            rows.append(
+                bench_mesh(args.network, args.dataset, w, pw, args.steps, args.compress)
+            )
         print(rows[-1], flush=True)
     base = rows[0]
+    thr_key = "tokens_per_sec" if args.workload == "lm" else "images_per_sec"
+    n_key = "shards" if args.workload == "lm" else "workers"
     for r in rows:
-        thr = r["images_per_sec"] / base["images_per_sec"]
+        thr = r[thr_key] / base[thr_key]
         r["speedup_vs_first"] = round(thr, 3)
-        r["scaling_efficiency"] = round(
-            thr / (r["workers"] / base["workers"]), 3
-        )
+        r["scaling_efficiency"] = round(thr / (r[n_key] / base[n_key]), 3)
     result = {
         "platform": jax.devices()[0].platform,
         "device_kind": jax.devices()[0].device_kind,

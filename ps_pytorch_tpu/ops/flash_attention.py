@@ -33,9 +33,22 @@ from typing import Optional
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 NEG_INF = -1e30
+
+
+def _mask_causal(scores, qi, ki, block_q, block_k):
+    """Apply the causal mask to one [block_q, block_k] score tile, with
+    positions taken from the grid indices. The ONE masking implementation
+    shared by the forward, dq, and dkv kernels — they must never diverge
+    or gradients silently stop matching the forward."""
+    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 0
+    )
+    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+        jnp.int32, (block_q, block_k), 1
+    )
+    return jnp.where(k_pos <= q_pos, scores, NEG_INF)
 
 
 def _pallas_mode() -> Optional[dict]:
@@ -70,13 +83,7 @@ def _make_fwd_kernel(scale, causal, block_q, block_k, n_k):
         scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
 
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            scores = jnp.where(k_pos <= q_pos, scores, NEG_INF)
+            scores = _mask_causal(scores, qi, ki, block_q, block_k)
 
         m_prev = m_ref[:]  # [Bq, 1]
         m_blk = jnp.max(scores, axis=-1, keepdims=True)
@@ -154,13 +161,7 @@ def _make_dq_kernel(scale, causal, block_q, block_k, n_k):
         delta = delta_ref[0][:, None]  # [Bq, 1]
         scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            scores = jnp.where(k_pos <= q_pos, scores, NEG_INF)
+            scores = _mask_causal(scores, qi, ki, block_q, block_k)
         p = jnp.exp(scores - lse)  # exact softmax probs, [Bq, Bk]
         # fully-masked rows: lse == NEG_INF and scores == NEG_INF give
         # exp(0) = 1; such rows contributed nothing forward, so zero them
@@ -194,13 +195,7 @@ def _make_dkv_kernel(scale, causal, block_q, block_k, n_q):
         delta = delta_ref[0][:, None]
         scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            q_pos = qi * block_q + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 0
-            )
-            k_pos = ki * block_k + jax.lax.broadcasted_iota(
-                jnp.int32, (block_q, block_k), 1
-            )
-            scores = jnp.where(k_pos <= q_pos, scores, NEG_INF)
+            scores = _mask_causal(scores, qi, ki, block_q, block_k)
         p = jnp.exp(scores - lse)  # [Bq, Bk]
         p = jnp.where(lse > NEG_INF / 2, p, 0.0)  # fully-masked rows (see dq)
         dv_acc[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
