@@ -91,6 +91,9 @@ def add_ps_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="where optimizer state lives (sharded = ZeRO-1 PS)")
     parser.add_argument("--bn-mode", type=str, default="pmean",
                         choices=("local", "pmean", "synced"))
+    parser.add_argument("--grad-accum-steps", type=int, default=1,
+                        help="microbatches accumulated per step (scales the "
+                             "effective per-worker batch beyond HBM)")
     parser.add_argument("--coordinator-address", type=str, default=None,
                         help="host:port for multi-host DCN rendezvous")
     parser.add_argument("--num-processes", type=int, default=None)
@@ -140,4 +143,5 @@ def ps_config_from(args: argparse.Namespace, num_workers: int) -> PSConfig:
         quant_rounding=args.quant_rounding,
         opt_placement=args.opt_placement,
         bn_mode=args.bn_mode,
+        grad_accum_steps=args.grad_accum_steps,
     )

@@ -70,8 +70,15 @@ class PSConfig:
     quant_rounding: str = "nearest"  # "nearest" | "stochastic" (unbiased)
     opt_placement: str = "replicated"  # "replicated" | "sharded"
     bn_mode: str = "pmean"  # "local" | "pmean" | "synced"
+    # microbatches per step, accumulated in an in-step lax.scan: scales the
+    # effective per-worker batch beyond HBM without touching the protocol
+    # (the reference can only shrink the batch; SURVEY section 6 shows its
+    # b=4096 runs were its scaling ceiling)
+    grad_accum_steps: int = 1
 
     def __post_init__(self):
+        if self.grad_accum_steps < 1:
+            raise ValueError(f"bad grad_accum_steps {self.grad_accum_steps}")
         if self.opt_placement not in ("replicated", "sharded"):
             raise ValueError(f"bad opt_placement {self.opt_placement!r}")
         if self.bn_mode not in ("local", "pmean", "synced"):
@@ -252,13 +259,52 @@ def make_ps_train_step(
             opt_state = tree_map(lambda a: a[0], opt_state)
         bs = tree_map(lambda a: a[0], batch_stats) if cfg.bn_mode == "local" else batch_stats
 
-        def loss_fn(p):
-            logits, new_bs = apply_model(model, p, bs, x, train=True, dropout_rng=k_drop)
-            return cross_entropy_loss(logits, labels), (logits, new_bs)
+        def fwd_bwd(bs_in, xi, yi, kd):
+            def loss_fn(p):
+                logits, new_bs = apply_model(
+                    model, p, bs_in, xi, train=True, dropout_rng=kd
+                )
+                return cross_entropy_loss(logits, yi), (logits, new_bs)
 
-        (loss, (logits, new_bs)), grads = jax.value_and_grad(loss_fn, has_aux=True)(
-            params
-        )
+            return jax.value_and_grad(loss_fn, has_aux=True)(params)
+
+        if cfg.grad_accum_steps > 1:
+            a = cfg.grad_accum_steps
+            if x.shape[0] % a:  # static shape: raises at trace time
+                raise ValueError(
+                    f"per-worker batch {x.shape[0]} not divisible by "
+                    f"grad_accum_steps={a}"
+                )
+            xm = x.reshape(a, x.shape[0] // a, *x.shape[1:])
+            ym = labels.reshape(a, -1)
+
+            def micro(carry, inp):
+                bs_c, gsum, lsum, p1sum, p5sum = carry
+                i, xi, yi = inp
+                (loss_i, (logits_i, bs_i)), g_i = fwd_bwd(
+                    bs_c, xi, yi, jax.random.fold_in(k_drop, i)
+                )
+                p1_i, p5_i = accuracy(logits_i, yi, (1, 5))
+                carry = (
+                    bs_i,
+                    tree_map(jnp.add, gsum, g_i),
+                    lsum + loss_i,
+                    p1sum + p1_i,
+                    p5sum + p5_i,
+                )
+                return carry, None
+
+            zeros = tree_map(jnp.zeros_like, params)
+            (new_bs, gsum, lsum, p1sum, p5sum), _ = lax.scan(
+                micro,
+                (bs, zeros, 0.0, 0.0, 0.0),
+                (jnp.arange(a), xm, ym),
+            )
+            grads = tree_map(lambda g: g / a, gsum)
+            loss, prec1, prec5 = lsum / a, p1sum / a, p5sum / a
+        else:
+            (loss, (logits, new_bs)), grads = fwd_bwd(bs, x, labels, k_drop)
+            prec1, prec5 = accuracy(logits, labels, (1, 5))
 
         if cfg.opt_placement == "sharded":
             params, new_opt = _sharded_ps_update(
@@ -287,7 +333,6 @@ def make_ps_train_step(
         else:
             out_bs = lax.pmean(new_bs, axis) if new_bs else new_bs
 
-        prec1, prec5 = accuracy(logits, labels, (1, 5))
         metrics = lax.pmean(
             {"loss": loss, "prec1": prec1, "prec5": prec5}, axis
         )

@@ -256,7 +256,9 @@ class Trainer:
                             timer.total,
                             t.straggler_threshold_s,
                         )
-                    if step_no % t.log_interval == 0 or step_no == 1:
+                    if t.log_interval > 0 and (
+                        step_no % t.log_interval == 0 or step_no == 1
+                    ):
                         logger.info(
                             format_iter_line(
                                 rank="mesh",
@@ -280,7 +282,14 @@ class Trainer:
                                 **{k: float(v) for k, v in metrics.items()},
                             },
                         )
-                    if t.save_checkpoints and step_no % t.eval_freq == 0:
+                    if (
+                        t.save_checkpoints
+                        # 0 = no periodic saves (the final checkpoint after
+                        # the loop still writes; use save_checkpoints=False
+                        # to suppress every write)
+                        and t.eval_freq > 0
+                        and step_no % t.eval_freq == 0
+                    ):
                         self._ckpt.save(
                             self.state,
                             t.train_dir,

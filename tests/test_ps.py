@@ -237,3 +237,75 @@ def test_stochastic_quantized_step_runs(mesh):
     a0 = jax.tree_util.tree_leaves(jax.device_get(state.params))[0]
     a1 = jax.tree_util.tree_leaves(jax.device_get(state2.params))[0]
     assert not np.allclose(a0, a1)
+
+
+def test_grad_accum_matches_single_shot(mesh):
+    """LeNet (no BN/dropout): accumulating A microbatches must produce the
+    IDENTICAL step as one full-batch pass — mean of microbatch grads equals
+    the full-batch grad, so params and loss match exactly."""
+    import jax
+    import numpy as np
+    from ps_pytorch_tpu.models import build_model
+    from ps_pytorch_tpu.optim import sgd
+    from ps_pytorch_tpu.parallel import (
+        PSConfig,
+        init_ps_state,
+        make_ps_train_step,
+        shard_batch,
+        shard_state,
+    )
+
+    model = build_model("LeNet")
+    tx = sgd(0.1, momentum=0.9)
+    rng = np.random.RandomState(0)
+    batch = {
+        "image": rng.randint(0, 255, (64, 28, 28, 1)).astype(np.uint8),
+        "label": rng.randint(0, 10, (64,)).astype(np.int32),
+    }
+    key = jax.random.key(3)
+
+    results = {}
+    for a in (1, 4):
+        cfg = PSConfig(num_workers=8, grad_accum_steps=a)
+        state = init_ps_state(model, tx, cfg, jax.random.key(0), (28, 28, 1))
+        state = shard_state(state, mesh, cfg)
+        step = make_ps_train_step(model, tx, cfg, mesh, donate=False)
+        new_state, m = step(state, shard_batch(batch, mesh, cfg), key)
+        results[a] = (jax.device_get(new_state.params), float(m["loss"]),
+                      float(m["prec1"]))
+
+    p1, l1, a1 = results[1]
+    p4, l4, a4 = results[4]
+    # mean-of-means vs one mean: same value up to reduction order
+    assert abs(l1 - l4) < 1e-4 and abs(a1 - a4) < 1e-3
+    for x, y in zip(jax.tree_util.tree_leaves(p1), jax.tree_util.tree_leaves(p4)):
+        np.testing.assert_allclose(np.asarray(x), np.asarray(y), rtol=1e-5, atol=1e-6)
+
+
+def test_grad_accum_indivisible_raises(mesh):
+    import jax
+    import numpy as np
+    import pytest
+    from ps_pytorch_tpu.models import build_model
+    from ps_pytorch_tpu.optim import sgd
+    from ps_pytorch_tpu.parallel import (
+        PSConfig,
+        init_ps_state,
+        make_ps_train_step,
+        shard_batch,
+        shard_state,
+    )
+
+    model = build_model("LeNet")
+    tx = sgd(0.1)
+    cfg = PSConfig(num_workers=8, grad_accum_steps=3)
+    state = shard_state(
+        init_ps_state(model, tx, cfg, jax.random.key(0), (28, 28, 1)), mesh, cfg
+    )
+    step = make_ps_train_step(model, tx, cfg, mesh)
+    batch = {
+        "image": np.zeros((64, 28, 28, 1), np.uint8),  # 8/worker, 8 % 3 != 0
+        "label": np.zeros((64,), np.int32),
+    }
+    with pytest.raises(ValueError, match="not divisible"):
+        step(state, shard_batch(batch, mesh, cfg), jax.random.key(0))
