@@ -94,6 +94,9 @@ def add_ps_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--grad-accum-steps", type=int, default=1,
                         help="microbatches accumulated per step (scales the "
                              "effective per-worker batch beyond HBM)")
+    parser.add_argument("--dcn-hosts", type=int, default=1,
+                        help=">1 = hierarchical dp over a (hosts x chips) "
+                             "hybrid mesh (ICI reduce first, one DCN hop)")
     parser.add_argument("--coordinator-address", type=str, default=None,
                         help="host:port for multi-host DCN rendezvous")
     parser.add_argument("--num-processes", type=int, default=None)
@@ -144,4 +147,5 @@ def ps_config_from(args: argparse.Namespace, num_workers: int) -> PSConfig:
         opt_placement=args.opt_placement,
         bn_mode=args.bn_mode,
         grad_accum_steps=args.grad_accum_steps,
+        dcn_hosts=args.dcn_hosts,
     )

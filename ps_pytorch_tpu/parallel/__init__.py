@@ -11,10 +11,13 @@ from .collectives import (
     quantized_psum,
 )
 from .mesh import (
+    DCN_AXIS,
     WORKER_AXIS,
     batch_sharding,
     initialize_multihost,
+    make_hybrid_mesh,
     make_mesh,
+    place_on_mesh,
     replicated_sharding,
 )
 from .ring_attention import (

@@ -38,6 +38,49 @@ def make_mesh(
     return Mesh(np.array(devs[:n]), (axis_name,))
 
 
+DCN_AXIS = "dcn"
+
+
+def make_hybrid_mesh(
+    num_hosts: Optional[int] = None,
+    per_host: Optional[int] = None,
+    axis_names: tuple = (DCN_AXIS, WORKER_AXIS),
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """2-level (hosts x chips-per-host) mesh for multi-host PS training:
+    the outer axis crosses DCN, the inner axis stays on ICI.
+
+    Use with `PSConfig(axis_name=(DCN_AXIS, WORKER_AXIS), num_workers=
+    total_chips)`: every collective in the PS engine takes the axis-name
+    tuple, so gradient aggregation psums hierarchically — XLA reduces
+    within each host over ICI first and crosses DCN once with the partial
+    sums, which is exactly the traffic layout the reference's star
+    topology cannot express (every worker's full gradient crossed the
+    network to the PS, SURVEY.md section 2 #2).
+
+    On a real pod (jax.process_count() > 1) device placement comes from
+    mesh_utils.create_hybrid_device_mesh; single-process (tests, one
+    host) falls back to a reshape of the flat device list.
+    """
+    devs = list(devices if devices is not None else jax.devices())
+    n_hosts = num_hosts or jax.process_count()
+    per = per_host or len(devs) // n_hosts
+    need = n_hosts * per
+    if need > len(devs) or per < 1:
+        raise ValueError(
+            f"need {n_hosts} hosts x {max(per, 1)} chips, have {len(devs)} devices"
+        )
+    if jax.process_count() > 1 and devices is None:
+        from jax.experimental import mesh_utils
+
+        grid = mesh_utils.create_hybrid_device_mesh(
+            (1, per), (n_hosts, 1), devices=devs[:need]
+        )
+    else:
+        grid = np.array(devs[:need]).reshape(n_hosts, per)
+    return Mesh(grid, axis_names)
+
+
 def place_on_mesh(tree, mesh: Mesh, specs):
     """Place every leaf of `tree` on `mesh` with its PartitionSpec from
     `specs` (a matching pytree of PartitionSpecs). None leaves (e.g. a

@@ -35,7 +35,7 @@ distributed_worker.py:239-252):
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, Callable, Optional
+from typing import Any, Callable, Optional, Tuple, Union
 
 import flax.struct
 import jax
@@ -62,7 +62,11 @@ class PSConfig:
     first K gradients to *arrive* (arrival order is nondeterministic)."""
 
     num_workers: int
-    axis_name: str = WORKER_AXIS
+    # a single mesh axis, or a TUPLE of axes for hierarchical (multi-host)
+    # data parallelism — e.g. (DCN_AXIS, WORKER_AXIS) over make_hybrid_mesh,
+    # where num_workers is the TOTAL chip count across hosts. Every
+    # collective in the engine accepts the tuple form.
+    axis_name: Union[str, Tuple[str, ...]] = WORKER_AXIS
     num_aggregate: Optional[int] = None
     mask_mode: str = "random_k"
     compress: Optional[str] = None  # None | "int8"
@@ -75,8 +79,25 @@ class PSConfig:
     # (the reference can only shrink the batch; SURVEY section 6 shows its
     # b=4096 runs were its scaling ceiling)
     grad_accum_steps: int = 1
+    # >1 = hierarchical data parallelism over a (hosts x chips) hybrid mesh
+    # (mesh.make_hybrid_mesh): axis_name is promoted to the axis tuple so
+    # aggregation reduces over ICI within a host before crossing DCN once
+    dcn_hosts: int = 1
 
     def __post_init__(self):
+        if self.dcn_hosts > 1:
+            if self.num_workers % self.dcn_hosts:
+                raise ValueError(
+                    f"num_workers {self.num_workers} not divisible by "
+                    f"dcn_hosts {self.dcn_hosts}"
+                )
+            if isinstance(self.axis_name, str):
+                from .mesh import DCN_AXIS
+
+                # frozen dataclass: promote the axis via object.__setattr__
+                object.__setattr__(
+                    self, "axis_name", (DCN_AXIS, self.axis_name)
+                )
         if self.grad_accum_steps < 1:
             raise ValueError(f"bad grad_accum_steps {self.grad_accum_steps}")
         if self.opt_placement not in ("replicated", "sharded"):

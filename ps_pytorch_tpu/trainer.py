@@ -118,7 +118,16 @@ class Trainer:
         self.dataset = dataset or prepare_data(
             tcfg.dataset, root=tcfg.data_root, allow_synthetic=tcfg.allow_synthetic
         )
-        self.mesh = make_mesh(num_workers=pcfg.num_workers)
+        if pcfg.dcn_hosts > 1:
+            from .parallel import make_hybrid_mesh
+
+            self.mesh = make_hybrid_mesh(
+                num_hosts=pcfg.dcn_hosts,
+                per_host=pcfg.num_workers // pcfg.dcn_hosts,
+                axis_names=pcfg.axis_name,
+            )
+        else:
+            self.mesh = make_mesh(num_workers=pcfg.num_workers)
         import jax.numpy as jnp
 
         compute_dtype = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}[tcfg.dtype]
