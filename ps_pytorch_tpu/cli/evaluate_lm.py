@@ -1,0 +1,146 @@
+"""Out-of-band LM evaluator — perplexity on a held-out split.
+
+The LM counterpart of cli/evaluate.py (which covers the CNN families;
+parity: /root/reference/src/distributed_evaluator.py polls checkpoints
+every 10 s and reports metrics out-of-band). Consumes the scheme-agnostic
+checkpoints train_lm writes — it never needs to know whether the producer
+ran dp_sp, tp, pp, dp_tp, or moe: dense checkpoints replay through
+apply_transformer, moe ones through apply_moe_transformer, single device.
+
+The eval split regenerates the SAME Markov chain the trainer used (the
+transition table is fixed by the recorded data seed) but walks fresh
+sequences (sequence_seed offset), so reported perplexity is held-out.
+
+  python -m ps_pytorch_tpu.cli.evaluate_lm --model-dir /tmp/lm --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import functools
+import math
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..checkpoint import load_checkpoint_raw, poll_checkpoints
+from ..ops.metrics import next_token_nll
+from ..utils import get_logger
+
+logger = get_logger()
+
+EVAL_SEQUENCE_SEED_OFFSET = 7919  # prime shift: held-out walks, same chain
+
+
+def _listify(tree):
+    """msgpack restores list-typed pytree nodes as dicts {'0': ..}; undo."""
+    if isinstance(tree, dict):
+        if tree and all(k.isdigit() for k in tree):
+            return [_listify(tree[str(i)]) for i in range(len(tree))]
+        return {k: _listify(v) for k, v in tree.items()}
+    return tree
+
+
+@functools.lru_cache(maxsize=8)
+def _cached_fwd(cfg, moe):
+    """One compiled forward per (model config, moe config) — the polling
+    loop evaluates many checkpoints of the same run and must not re-trace
+    (a fresh jit(lambda) per checkpoint recompiles every poll)."""
+    from ..models.transformer import apply_transformer
+
+    if moe is not None:
+        from ..parallel.moe import apply_moe_transformer
+
+        return jax.jit(
+            lambda p, t: apply_moe_transformer(cfg, moe, p, t, None)[0]
+        )
+    return jax.jit(lambda p, t: apply_transformer(cfg, p, t))
+
+
+def evaluate_checkpoint(model_dir: str, step: int, eval_size: int = 64,
+                        batch_size: int = 16) -> dict:
+    from ..models.transformer import TransformerConfig
+    from .train_lm import make_synthetic_tokens
+
+    raw = load_checkpoint_raw(model_dir, step)
+    params = _listify(raw["params"])
+    params = jax.tree.map(jnp.asarray, params)
+    m = raw["model"]
+    cfg = TransformerConfig(
+        vocab_size=int(m["vocab_size"]),
+        dim=int(m["dim"]),
+        depth=int(m["depth"]),
+        heads=int(m["heads"]),
+        mlp_ratio=int(m["mlp_ratio"]),
+        max_seq_len=int(m["max_seq_len"]),
+    )
+    seq_len = int(raw["data"]["seq_len"])
+    toks = make_synthetic_tokens(
+        cfg.vocab_size,
+        eval_size,
+        seq_len,
+        seed=int(raw["data"]["seed"]),
+        sequence_seed=int(raw["data"]["seed"]) + EVAL_SEQUENCE_SEED_OFFSET,
+    )
+
+    if m["kind"] == "moe":
+        from ..parallel.moe import MoEConfig
+
+        moe = MoEConfig(
+            num_experts=int(m["num_experts"]),
+            capacity_factor=float(m["capacity_factor"]),
+        )
+    else:
+        moe = None
+    fwd = _cached_fwd(cfg, moe)
+
+    total, count = 0.0, 0
+    for i in range(0, eval_size, batch_size):
+        t = jnp.asarray(toks[i : i + batch_size])
+        total += float(next_token_nll(fwd(params, t), t)) * t.shape[0]
+        count += t.shape[0]
+    nll = total / count
+    return {"step": step, "loss": nll, "perplexity": math.exp(nll)}
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser("ps_pytorch_tpu.cli.evaluate_lm")
+    p.add_argument("--model-dir", required=True)
+    p.add_argument("--eval-size", type=int, default=64,
+                   help="held-out sequences per evaluation")
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--once", action="store_true",
+                   help="evaluate the latest checkpoint and exit")
+    p.add_argument("--poll-interval", type=float, default=10.0)
+    p.add_argument("--timeout", type=float, default=None,
+                   help="stop after this long with no new checkpoint")
+    args = p.parse_args(argv)
+
+    results = {}
+    if args.once:
+        from ..checkpoint import latest_step
+
+        step = latest_step(args.model_dir)
+        if step is None:
+            raise FileNotFoundError(f"no checkpoints in {args.model_dir}")
+        steps = [step]
+    else:
+        steps = poll_checkpoints(
+            args.model_dir, interval_s=args.poll_interval,
+            timeout_s=args.timeout,
+        )
+    for step in steps:
+        r = evaluate_checkpoint(
+            args.model_dir, step, args.eval_size, args.batch_size
+        )
+        results[step] = r
+        logger.info(
+            "LM Validation Step: %d, Loss: %.4f, Perplexity: %.3f",
+            r["step"], r["loss"], r["perplexity"],
+        )
+    return results
+
+
+if __name__ == "__main__":
+    main()

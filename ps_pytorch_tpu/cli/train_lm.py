@@ -28,6 +28,7 @@ from __future__ import annotations
 
 import argparse
 import time
+from typing import Optional
 
 import jax
 import jax.numpy as jnp
@@ -48,16 +49,22 @@ def make_synthetic_tokens(
     seq_len: int,
     seed: int = 0,
     branching: int = 4,
+    sequence_seed: Optional[int] = None,
 ) -> np.ndarray:
     """Sequences from a fixed sparse Markov chain: every token transitions
     uniformly to one of `branching` fixed successors -> cross-entropy floor
-    of log(branching) nats that a working LM approaches."""
+    of log(branching) nats that a working LM approaches.
+
+    `seed` fixes the transition table; `sequence_seed` (default = seed)
+    draws the walks — pass a different one for a held-out eval split over
+    the SAME chain (what cli/evaluate_lm.py does)."""
     rng = np.random.RandomState(seed)
     successors = rng.randint(0, vocab_size, size=(vocab_size, branching))
+    srng = rng if sequence_seed is None else np.random.RandomState(sequence_seed)
     toks = np.empty((n_sequences, seq_len), np.int32)
-    toks[:, 0] = rng.randint(0, vocab_size, n_sequences)
+    toks[:, 0] = srng.randint(0, vocab_size, n_sequences)
     for t in range(1, seq_len):
-        pick = rng.randint(0, branching, n_sequences)
+        pick = srng.randint(0, branching, n_sequences)
         toks[:, t] = successors[toks[:, t - 1], pick]
     return toks
 
@@ -99,6 +106,11 @@ def main(argv=None) -> dict:
     parser.add_argument("--train-size", type=int, default=512,
                         help="synthetic corpus size (sequences)")
     parser.add_argument("--metrics-file", type=str, default=None)
+    parser.add_argument("--train-dir", type=str, default=None,
+                        help="checkpoint dir (scheme-agnostic plain layout; "
+                             "consumed by cli.evaluate_lm)")
+    parser.add_argument("--eval-freq", type=int, default=0,
+                        help="checkpoint every N steps (0 = only at the end)")
     args = parser.parse_args(argv)
 
     if args.attention_impl == "flash" and args.parallelism == "dp_sp":
@@ -139,14 +151,21 @@ def main(argv=None) -> dict:
         opt_state = tx.init(params)
         step = make_lm_train_step(cfg, tx, mesh)
         run = lambda p, o, tok: step(p, o, shard_tokens_2d(jnp.asarray(tok), mesh))
+        to_plain = lambda p: p
         layout = f"dp {args.num_dp} x sp {num_sp} ({args.sp_attention})"
     elif args.parallelism == "tp":
-        from ..parallel.tp import init_tp_state, make_tp_mesh, make_tp_train_step
+        from ..parallel.tp import (
+            from_tp_layout,
+            init_tp_state,
+            make_tp_mesh,
+            make_tp_train_step,
+        )
 
         mesh = make_tp_mesh(n_shards)
         params, opt_state = init_tp_state(cfg, tx, key, mesh)
         step = make_tp_train_step(cfg, tx, mesh)
         run = lambda p, o, tok: step(p, o, jnp.asarray(tok))
+        to_plain = lambda p: from_tp_layout(cfg, p)
         layout = f"tp {n_shards}"
     elif args.parallelism == "dp_tp":
         from ..parallel.dp_tp import (
@@ -155,6 +174,7 @@ def main(argv=None) -> dict:
             make_mesh_dp_tp,
             shard_tokens_dp,
         )
+        from ..parallel.tp import from_tp_layout
 
         num_tp = args.num_shards or max(n_dev // args.num_dp, 1)
         if args.batch_size % args.num_dp:
@@ -165,9 +185,15 @@ def main(argv=None) -> dict:
         params, opt_state = init_dp_tp_state(cfg, tx, key, mesh)
         step = make_dp_tp_train_step(cfg, tx, mesh)
         run = lambda p, o, tok: step(p, o, shard_tokens_dp(jnp.asarray(tok), mesh))
+        to_plain = lambda p: from_tp_layout(cfg, p)
         layout = f"dp {args.num_dp} x tp {num_tp}"
     elif args.parallelism == "pp":
-        from ..parallel.pp import init_pp_state, make_pp_mesh, make_pp_train_step
+        from ..parallel.pp import (
+            from_pp_layout,
+            init_pp_state,
+            make_pp_mesh,
+            make_pp_train_step,
+        )
 
         if args.batch_size % args.num_microbatches:
             raise ValueError(
@@ -180,6 +206,7 @@ def main(argv=None) -> dict:
             cfg, tx, mesh, num_microbatches=args.num_microbatches
         )
         run = lambda p, o, tok: step(p, o, jnp.asarray(tok))
+        to_plain = lambda p: from_pp_layout(cfg, p)
         layout = f"pp {n_shards} x {args.num_microbatches} microbatches"
     else:  # moe
         from ..parallel.moe import (
@@ -207,6 +234,7 @@ def main(argv=None) -> dict:
             aux_box["aux"] = aux
             return p, o, loss
 
+        to_plain = lambda p: p  # MoE layout IS the model (evaluator branches)
         layout = f"moe {args.num_experts} experts over {n_shards} shards"
 
     corpus = make_synthetic_tokens(
@@ -217,6 +245,35 @@ def main(argv=None) -> dict:
         "LM %dx d%d h%d (%d params), seq %d, %s",
         args.depth, args.dim, args.heads, n_params, args.seq_len, layout,
     )
+
+    def save_lm_checkpoint(step_no):
+        if args.train_dir is None:
+            return
+        from ..checkpoint import save_checkpoint
+
+        # plain-layout params + enough metadata for a structure-free
+        # evaluator (cli/evaluate_lm.py) to rebuild the model and the
+        # held-out eval split of the same Markov chain
+        save_checkpoint(
+            {
+                "params": jax.device_get(to_plain(params)),
+                "step": step_no,
+                "model": {
+                    "kind": "moe" if args.parallelism == "moe" else "dense",
+                    "vocab_size": cfg.vocab_size,
+                    "dim": cfg.dim,
+                    "depth": cfg.depth,
+                    "heads": cfg.heads,
+                    "mlp_ratio": cfg.mlp_ratio,
+                    "max_seq_len": cfg.max_seq_len,
+                    "num_experts": args.num_experts,
+                    "capacity_factor": float(args.capacity_factor),
+                },
+                "data": {"seed": args.seed + 1, "seq_len": args.seq_len},
+            },
+            args.train_dir,
+            step_no,
+        )
 
     rng = np.random.RandomState(args.seed + 2)
     loss = float("nan")
@@ -250,6 +307,12 @@ def main(argv=None) -> dict:
                 record["aux_loss"] = round(float(aux_box["aux"]), 6)
                 logger.info("MoE load-balance aux: %.4f", record["aux_loss"])
             append_metrics_line(args.metrics_file, record)
+        if args.eval_freq > 0 and step_no % args.eval_freq == 0:
+            save_lm_checkpoint(step_no)
+    if args.train_dir is not None and (
+        args.eval_freq <= 0 or args.max_steps % args.eval_freq
+    ):
+        save_lm_checkpoint(args.max_steps)
     return {"loss": float(loss), "params": n_params}
 
 

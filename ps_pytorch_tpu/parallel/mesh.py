@@ -71,10 +71,24 @@ def make_hybrid_mesh(
             f"need {n_hosts} hosts x {max(per, 1)} chips, have {len(devs)} devices"
         )
     if jax.process_count() > 1 and devices is None:
+        # subsets must stay balanced PER HOST: take the leading `per` chips
+        # of each of the first n_hosts processes (a flat devs[:need] slice
+        # would take all of host 0 first and leave later hosts empty)
+        by_host: dict = {}
+        for d in devs:
+            by_host.setdefault(d.process_index, []).append(d)
+        hosts = sorted(by_host)[:n_hosts]
+        if any(len(by_host[h]) < per for h in hosts) or len(hosts) < n_hosts:
+            raise ValueError(
+                f"need {n_hosts} hosts x {per} chips, have "
+                f"{ {h: len(v) for h, v in by_host.items()} }"
+            )
+        picked = [d for h in hosts for d in by_host[h][:per]]
         from jax.experimental import mesh_utils
 
+        # granule = process (host), matching this function's contract
         grid = mesh_utils.create_hybrid_device_mesh(
-            (1, per), (n_hosts, 1), devices=devs[:need]
+            (1, per), (n_hosts, 1), devices=picked, process_is_granule=True
         )
     else:
         grid = np.array(devs[:need]).reshape(n_hosts, per)

@@ -336,3 +336,41 @@ def test_cli_train_lm_parallelism_modes(extra):
     # random guessing = log(32) = 3.47, the Markov floor = log(4) = 1.39;
     # match the dp_sp test's bar so a merely-crippled scheme still fails
     assert out["loss"] < 3.0, out
+
+
+@pytest.mark.parametrize(
+    "extra",
+    [
+        ["--parallelism", "tp", "--heads", "8"],
+        ["--parallelism", "pp", "--depth", "8"],
+        ["--parallelism", "moe", "--num-experts", "8"],
+        ["--num-dp", "2"],  # dp_sp default path
+    ],
+    ids=["tp", "pp", "moe", "dp_sp"],
+)
+def test_cli_train_lm_checkpoint_evaluate_round_trip(tmp_path, extra):
+    """Every scheme writes scheme-agnostic checkpoints that the LM
+    evaluator replays single-device, reporting held-out perplexity."""
+    from ps_pytorch_tpu.cli.evaluate_lm import main as eval_main
+    from ps_pytorch_tpu.cli.train_lm import main as train_main
+
+    d = str(tmp_path / "lm")
+    train_main(
+        [
+            "--seq-len", "32", "--batch-size", "8", "--max-steps", "25",
+            "--dim", "64", "--depth", "8" if "pp" in extra else "1",
+            "--vocab-size", "32", "--lr", "0.3", "--log-interval", "25",
+            "--train-dir", d, "--eval-freq", "20",
+        ]
+        + extra
+    )
+    results = eval_main(
+        ["--model-dir", d, "--poll-interval", "0.01", "--timeout", "0.0",
+         "--eval-size", "32"]
+    )
+    assert sorted(results) == [20, 25]
+    for r in results.values():
+        assert np.isfinite(r["loss"])
+    # held-out perplexity clearly better than uniform (vocab 32) after 25
+    # steps on the branching-4 chain
+    assert results[25]["perplexity"] < 25.0, results
