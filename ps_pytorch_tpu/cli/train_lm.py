@@ -33,6 +33,7 @@ from typing import Optional
 import jax
 import jax.numpy as jnp
 import numpy as np
+import optax
 
 from ..models.transformer import TransformerConfig, init_transformer
 from ..optim import build_optimizer
@@ -84,6 +85,14 @@ def main(argv=None) -> dict:
     parser.add_argument("--max-steps", type=int, default=100)
     parser.add_argument("--lr", type=float, default=0.1)
     parser.add_argument("--momentum", type=float, default=0.9)
+    parser.add_argument("--optimizer", default="sgd",
+                        choices=["sgd", "adam", "amsgrad"])
+    parser.add_argument("--weight-decay", type=float, default=0.0)
+    parser.add_argument("--lr-schedule", default="constant",
+                        choices=["constant", "cosine"])
+    parser.add_argument("--warmup-steps", type=int, default=0)
+    parser.add_argument("--dtype", default="float32",
+                        choices=["float32", "bfloat16"])
     parser.add_argument("--seed", type=int, default=1)
     parser.add_argument("--log-interval", type=int, default=10)
     parser.add_argument("--remat", action="store_true")
@@ -129,8 +138,31 @@ def main(argv=None) -> dict:
         bidirectional_ring=args.bidirectional_ring,
         sp_attention=args.sp_attention,
         attention_impl=args.attention_impl,
+        # mixed precision: params/grads/moments stay f32 (bf16 Adam moments
+        # are broken — bf16(0.999) == 1.0); block math runs in bf16
+        compute_dtype=jnp.bfloat16 if args.dtype == "bfloat16" else None,
     )
-    tx = build_optimizer("sgd", args.lr, momentum=args.momentum)
+    if args.lr_schedule == "cosine":
+        lr = optax.warmup_cosine_decay_schedule(
+            init_value=0.0,
+            peak_value=args.lr,
+            warmup_steps=args.warmup_steps,
+            decay_steps=max(args.max_steps, args.warmup_steps + 1),
+        )
+    elif args.warmup_steps > 0:
+        lr = optax.join_schedules(
+            [
+                optax.linear_schedule(0.0, args.lr, args.warmup_steps),
+                optax.constant_schedule(args.lr),
+            ],
+            [args.warmup_steps],
+        )
+    else:
+        lr = args.lr
+    tx = build_optimizer(
+        args.optimizer, lr, momentum=args.momentum,
+        weight_decay=args.weight_decay,
+    )
     n_dev = len(jax.devices())
     n_shards = args.num_shards or n_dev
     key = jax.random.key(args.seed)

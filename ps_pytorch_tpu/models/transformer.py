@@ -52,6 +52,15 @@ class TransformerConfig:
     # single-device, tp, pp, and moe paths; the sp paths communicate via
     # ring/ulysses and keep their own per-block math
     attention_impl: str = "naive"
+    # mixed precision: params/optimizer state stay `dtype` (keep f32 —
+    # bf16 Adam moments are broken: bf16(0.999) == 1.0), while block
+    # matmuls/attention run in `compute_dtype` (None = same as dtype).
+    # Same convention as the CNN trainer's --dtype bfloat16.
+    compute_dtype: Any = None
+
+    @property
+    def effective_compute_dtype(self):
+        return self.compute_dtype if self.compute_dtype is not None else self.dtype
 
     @property
     def head_dim(self) -> int:
@@ -121,6 +130,11 @@ def transformer_block(cfg: TransformerConfig, x, blk, attend, mlp=None):
     `attend` maps ([B,T,H,hd],)*3 -> [B,T,H,hd]; `mlp` (optional) replaces
     the dense GELU MLP, mapping the normed hidden [B,T,D] -> [B,T,D].
     """
+    cd = cfg.effective_compute_dtype
+    x = x.astype(cd)
+    # cast weights at use, not at init: params (and grads/moments) keep
+    # their storage dtype; only the block math runs in compute_dtype
+    blk = {k: v.astype(cd) for k, v in blk.items()}
     b, t = x.shape[0], x.shape[1]
     h = _rms_norm(x, blk["ln1"])
     qkv = h @ blk["wqkv"]
@@ -181,7 +195,9 @@ def apply_transformer(
     for blk in params["blocks"]:
         x = block(x, blk)
 
-    return _rms_norm(x, params["out_norm"]) @ params["embed"].T
+    cd = cfg.effective_compute_dtype
+    xf = _rms_norm(x.astype(cd), params["out_norm"].astype(cd))
+    return xf @ params["embed"].T.astype(cd)
 
 
 def make_sp_forward(

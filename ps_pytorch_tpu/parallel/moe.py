@@ -158,7 +158,10 @@ def moe_mlp_local(h, blk, moe: MoEConfig, axis_name: Optional[str]):
     x2d = h.reshape(b * t, d)
     e = moe.num_experts
     capacity = int(np.ceil(b * t * moe.capacity_factor / e))
-    dispatch, combine, aux = _gate_and_dispatch(x2d, blk["wg"], capacity)
+    # cast at use: params may be stored f32 while activations run bf16
+    dispatch, combine, aux = _gate_and_dispatch(
+        x2d, blk["wg"].astype(h.dtype), capacity
+    )
     # gating runs in f32; the dispatch/combine one-hots drop back to the
     # activation dtype so the expert matmuls stay on the bf16 path
     dispatch = dispatch.astype(h.dtype)
@@ -170,7 +173,8 @@ def moe_mlp_local(h, blk, moe: MoEConfig, axis_name: Optional[str]):
         expert_in = lax.all_to_all(
             expert_in, axis_name, split_axis=0, concat_axis=1, tiled=True
         )  # [E/n, n*C, D]
-    w_up, w_down = blk["w_up_e"], blk["w_down_e"]  # local experts
+    w_up = blk["w_up_e"].astype(h.dtype)  # local experts, compute dtype
+    w_down = blk["w_down_e"].astype(h.dtype)
     expert_out = jnp.einsum(
         "ecm,emd->ecd",
         jax.nn.gelu(jnp.einsum("ecd,edm->ecm", expert_in, w_up)),
@@ -223,7 +227,9 @@ def apply_moe_transformer(
         x, aux = block_fn(x, blk)
         aux_total = aux_total + aux
 
-    logits = _rms_norm(x, params["out_norm"]) @ params["embed"].T
+    cd = cfg.effective_compute_dtype
+    xf = _rms_norm(x.astype(cd), params["out_norm"].astype(cd))
+    logits = xf @ params["embed"].T.astype(cd)
     return logits, aux_total / cfg.depth
 
 

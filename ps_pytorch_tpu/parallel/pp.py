@@ -132,11 +132,12 @@ def _pp_logits_and_loss(
         tok = lax.dynamic_index_in_dim(
             tokens, jnp.clip(mb_idx, 0, m - 1), 0, keepdims=False
         )
-        return params["embed"][tok] + params["pos_embed"][pos][None]
+        return (params["embed"][tok] + params["pos_embed"][pos][None]).astype(cd)
 
     perm = [(j, (j + 1) % n) for j in range(n)]
-    outputs0 = jnp.zeros((m, b_mb, t, cfg.dim), params["embed"].dtype)
-    y0 = jnp.zeros((b_mb, t, cfg.dim), params["embed"].dtype)
+    cd = cfg.effective_compute_dtype  # blocks emit compute_dtype activations
+    outputs0 = jnp.zeros((m, b_mb, t, cfg.dim), cd)
+    y0 = jnp.zeros((b_mb, t, cfg.dim), cd)
 
     def tick(carry, tk):
         y, outputs = carry
@@ -157,8 +158,8 @@ def _pp_logits_and_loss(
 
     # unembed + loss on the last stage (computed uniformly on all stages;
     # only the last stage's value survives the mask+psum)
-    xf = _rms_norm(outputs, params["out_norm"])
-    logits = xf @ params["embed"].T  # [M, B_mb, T, V]
+    xf = _rms_norm(outputs, params["out_norm"].astype(cd))
+    logits = xf @ params["embed"].T.astype(cd)  # [M, B_mb, T, V]
     loss_local = next_token_nll(logits, tokens)
     return lax.psum(jnp.where(stage == n - 1, loss_local, 0.0), axis_name)
 

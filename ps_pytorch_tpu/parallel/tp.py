@@ -143,7 +143,11 @@ def apply_transformer_tp(
     pos = jnp.arange(t)
     x = params["embed"][tokens] + params["pos_embed"][pos][None]
 
+    cd = cfg.effective_compute_dtype
+
     def block(x, blk):
+        x = x.astype(cd)
+        blk = {k: v.astype(cd) for k, v in blk.items()}  # cast at use
         h = _rms_norm(x, blk["ln1"])
         qkv = jnp.einsum("btd,dchk->btchk", h, blk["wqkv"])  # [B,T,3,Hloc,hd]
         q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
@@ -158,7 +162,8 @@ def apply_transformer_tp(
         block = jax.checkpoint(block)
     for blk in params["blocks"]:
         x = block(x, blk)
-    return _rms_norm(x, params["out_norm"]) @ params["embed"].T
+    xf = _rms_norm(x.astype(cd), params["out_norm"].astype(cd))
+    return xf @ params["embed"].T.astype(cd)
 
 
 def make_tp_forward(
