@@ -59,7 +59,7 @@ def _cached_fwd(cfg, moe):
 
 
 def evaluate_checkpoint(model_dir: str, step: int, eval_size: int = 64,
-                        batch_size: int = 16) -> dict:
+                        batch_size: int = 16, generate_tokens: int = 0) -> dict:
     from ..models.transformer import TransformerConfig
     from .train_lm import make_synthetic_tokens
 
@@ -101,7 +101,32 @@ def evaluate_checkpoint(model_dir: str, step: int, eval_size: int = 64,
         total += float(next_token_nll(fwd(params, t), t)) * t.shape[0]
         count += t.shape[0]
     nll = total / count
-    return {"step": step, "loss": nll, "perplexity": math.exp(nll)}
+    out = {"step": step, "loss": nll, "perplexity": math.exp(nll)}
+
+    if generate_tokens > 0:
+        if m["kind"] == "moe":
+            logger.info("generation: MoE checkpoints have no decode path yet")
+        else:
+            from ..models.decode import generate
+
+            prompt = jnp.asarray(toks[:2, : min(8, seq_len // 2)])
+            # clamp to the model's positional range (never crash the
+            # long-running polling process over a sampling nicety)
+            n_new = min(generate_tokens, cfg.max_seq_len - prompt.shape[1])
+            if n_new < generate_tokens:
+                logger.info(
+                    "generation: clamping %d -> %d tokens (max_seq_len %d)",
+                    generate_tokens, n_new, cfg.max_seq_len,
+                )
+            sample = generate(
+                cfg, params, prompt, max_new_tokens=n_new,
+                temperature=0.8, key=jax.random.key(step),
+                max_len=prompt.shape[1] + n_new,
+            )
+            out["samples"] = np.asarray(sample).tolist()
+            for row in out["samples"]:
+                logger.info("sample: %s", " ".join(map(str, row)))
+    return out
 
 
 def main(argv=None) -> dict:
@@ -115,6 +140,9 @@ def main(argv=None) -> dict:
     p.add_argument("--poll-interval", type=float, default=10.0)
     p.add_argument("--timeout", type=float, default=None,
                    help="stop after this long with no new checkpoint")
+    p.add_argument("--generate", type=int, default=0,
+                   help="also sample N tokens from 2 held-out prompts "
+                        "(KV-cache decode; dense checkpoints only)")
     args = p.parse_args(argv)
 
     results = {}
@@ -132,7 +160,8 @@ def main(argv=None) -> dict:
         )
     for step in steps:
         r = evaluate_checkpoint(
-            args.model_dir, step, args.eval_size, args.batch_size
+            args.model_dir, step, args.eval_size, args.batch_size,
+            generate_tokens=args.generate,
         )
         results[step] = r
         logger.info(

@@ -1,0 +1,156 @@
+"""Autoregressive decoding with a KV cache for the transformer LM.
+
+No reference counterpart (the reference is CNN-only); this completes the
+LM family as a usable product: train (cli/train_lm) -> evaluate
+(cli/evaluate_lm) -> generate (here).
+
+Design is XLA-native: the cache is a pair of [B, max_len, H, hd] buffers
+per block, written with `lax.dynamic_update_slice` at the current
+position; the whole decode loop is ONE `lax.scan` over step indices
+(static shapes, no Python control flow), so it compiles once for a given
+(batch, max_len). Attention over the cache masks positions >= the current
+length — exact equality with re-running the full forward is tested.
+
+Sampling: greedy (temperature=0) or temperature sampling driven by a PRNG
+key, both inside the scan.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .transformer import TransformerConfig, _rms_norm
+
+NEG_INF = -1e30
+
+
+def init_kv_cache(
+    cfg: TransformerConfig, batch: int, max_len: Optional[int] = None
+) -> Dict:
+    """Zeroed [B, L, H, hd] K/V buffers per block (compute dtype)."""
+    L = max_len or cfg.max_seq_len
+    cd = cfg.effective_compute_dtype
+    shape = (batch, L, cfg.heads, cfg.head_dim)
+    return {
+        "k": jnp.zeros((cfg.depth,) + shape, cd),
+        "v": jnp.zeros((cfg.depth,) + shape, cd),
+    }
+
+
+def _attend_cached(q, k_cache, v_cache, length, scale):
+    """q [B, 1, H, hd] against cache[:, :L]; positions >= length masked.
+
+    length is a traced scalar (the number of valid cache slots, including
+    the position q is at)."""
+    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) * scale  # [B,H,1,L]
+    pos = jnp.arange(k_cache.shape[1])
+    scores = jnp.where(pos[None, None, None, :] < length, scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    return jnp.einsum("bhqk,bkhd->bqhd", p, v_cache)
+
+
+def _decode_one(cfg: TransformerConfig, params: Dict, cache: Dict,
+                token: jax.Array, pos: jax.Array) -> Tuple[jax.Array, Dict]:
+    """One token [B] at position pos -> (logits [B, V], updated cache).
+
+    Block math comes from transformer_block (the single source — training
+    and decoding cannot diverge); only `attend` differs: it writes this
+    step's K/V into the stacked cache IN PLACE (one [depth,B,L,H,hd]
+    dynamic_update_slice per block, no full-cache re-stack) and attends
+    over the valid prefix.
+    """
+    from .transformer import transformer_block
+
+    cd = cfg.effective_compute_dtype
+    x = (params["embed"][token] + params["pos_embed"][pos][None]).astype(cd)
+    x = x[:, None]  # [B, 1, D]
+    scale = 1.0 / (cfg.head_dim ** 0.5)
+    k_buf, v_buf = cache["k"], cache["v"]
+
+    for i, blk in enumerate(params["blocks"]):
+
+        def attend(q, k, v, _i=i):
+            nonlocal k_buf, v_buf
+            k_buf = lax.dynamic_update_slice(
+                k_buf, k.astype(k_buf.dtype)[None], (_i, 0, pos, 0, 0)
+            )
+            v_buf = lax.dynamic_update_slice(
+                v_buf, v.astype(v_buf.dtype)[None], (_i, 0, pos, 0, 0)
+            )
+            return _attend_cached(q, k_buf[_i], v_buf[_i], pos + 1, scale)
+
+        x = transformer_block(cfg, x, blk, attend)
+
+    cache = {"k": k_buf, "v": v_buf}
+    xf = _rms_norm(x[:, 0].astype(cd), params["out_norm"].astype(cd))
+    logits = xf @ params["embed"].T.astype(cd)  # [B, V]
+    return logits.astype(jnp.float32), cache
+
+
+def generate(
+    cfg: TransformerConfig,
+    params: Dict,
+    prompt: jax.Array,  # int32 [B, T_prompt]
+    max_new_tokens: int,
+    temperature: float = 0.0,
+    key: Optional[jax.Array] = None,
+    max_len: Optional[int] = None,
+) -> jax.Array:
+    """Generate greedily (temperature=0) or by temperature sampling.
+
+    Returns int32 [B, T_prompt + max_new_tokens]. The prompt is prefilled
+    through the same single-token decode path inside one scan (simple and
+    cache-exact; a batched prefill is a future optimization), then
+    generation continues from the last prompt token.
+    """
+    b, t_prompt = prompt.shape
+    L = max_len or cfg.max_seq_len
+    total = t_prompt + max_new_tokens
+    if total > L:
+        raise ValueError(f"prompt {t_prompt} + new {max_new_tokens} > {L}")
+    if temperature > 0 and key is None:
+        raise ValueError("temperature sampling needs a PRNG key")
+    key = key if key is not None else jax.random.key(0)
+
+    cache0 = init_kv_cache(cfg, b, L)
+    # tokens buffer holds the prompt then generated ids
+    buf0 = jnp.zeros((b, total), jnp.int32).at[:, :t_prompt].set(prompt)
+
+    def step(carry, pos):
+        buf, cache, k = carry
+        token = buf[:, pos]  # current input token
+        logits, cache = _decode_one(cfg, params, cache, token, pos)
+        k, ks = jax.random.split(k)
+        if temperature > 0:
+            nxt = jax.random.categorical(ks, logits / temperature, axis=-1)
+        else:
+            nxt = jnp.argmax(logits, axis=-1)
+        # write the prediction at pos+1 ONLY in the generation region
+        # (prompt positions keep their given tokens — teacher forcing)
+        write = pos + 1 >= t_prompt
+        nxt = jnp.where(write, nxt, buf[:, jnp.minimum(pos + 1, total - 1)])
+        buf = lax.dynamic_update_slice(
+            buf, nxt[:, None].astype(jnp.int32), (0, pos + 1)
+        )
+        return (buf, cache, k), None
+
+    (buf, _, _), _ = lax.scan(
+        step, (buf0, cache0, key), jnp.arange(total - 1)
+    )
+    return buf
+
+
+def make_generate(cfg: TransformerConfig, max_new_tokens: int,
+                  temperature: float = 0.0, max_len: Optional[int] = None):
+    """Jitted generate: (params, prompt [B, T], key) -> [B, T + new]."""
+    def fn(params, prompt, key):
+        return generate(
+            cfg, params, prompt, max_new_tokens,
+            temperature=temperature, key=key, max_len=max_len,
+        )
+
+    return jax.jit(fn)
