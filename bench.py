@@ -11,7 +11,10 @@ canonical config (src/run_pytorch.sh) — through this framework's PS train
 step on the available accelerator, and reports throughput.
 
 Prints exactly ONE JSON line:
-  {"metric": ..., "value": N, "unit": "images/sec", "vs_baseline": N}
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+(unit is images/sec for the lenet/resnet18 workloads, tokens/sec for the
+opt-in BENCH_WORKLOAD=lm transformer workload; the lm metric name encodes
+the measured config).
 """
 
 import json
@@ -38,7 +41,61 @@ WORKLOADS = {
     "resnet18": dict(network="ResNet18", dataset="Cifar10", batch=1024,
                      compress="int8",
                      metric="resnet18_cifar10_b1024_train_throughput"),
+    # beyond the reference (it has no LM workloads): one-chip transformer
+    # training throughput in tokens/sec; vs_baseline is per-sample against
+    # the same reference normalization (apples-to-oranges, labeled as such).
+    # The metric name is built from the actual (env-overridable) config.
+    "lm": dict(metric=None),
 }
+
+
+def _bench_lm(steps: int) -> tuple:
+    import jax
+    import jax.numpy as jnp
+
+    from ps_pytorch_tpu.cli.train_lm import make_synthetic_tokens
+    from ps_pytorch_tpu.models.transformer import (
+        TransformerConfig,
+        init_transformer,
+    )
+    from ps_pytorch_tpu.optim import sgd
+    from ps_pytorch_tpu.parallel.dp_sp import (
+        make_lm_train_step,
+        make_mesh_2d,
+        shard_tokens_2d,
+    )
+    from ps_pytorch_tpu.utils import host_sync
+
+    # TPU-sized defaults; BENCH_LM_* env overrides shrink for CPU smoke
+    batch = int(os.environ.get("BENCH_LM_BATCH", 8))
+    seq = int(os.environ.get("BENCH_LM_SEQ", 1024))
+    cfg = TransformerConfig(
+        vocab_size=2048,
+        dim=int(os.environ.get("BENCH_LM_DIM", 512)),
+        depth=int(os.environ.get("BENCH_LM_DEPTH", 6)),
+        heads=8,
+        max_seq_len=seq,
+        remat=True,
+        compute_dtype=jnp.bfloat16,
+    )
+    mesh = make_mesh_2d(1, 1)  # single chip; dp/sp degenerate
+    tx = sgd(0.01, momentum=0.9)
+    params = init_transformer(cfg, jax.random.key(0))
+    opt = tx.init(params)
+    step = make_lm_train_step(cfg, tx, mesh)
+    corpus = make_synthetic_tokens(cfg.vocab_size, max(64, batch), seq, seed=0)
+    tok = shard_tokens_2d(jnp.asarray(corpus[:batch]), mesh)
+
+    for _ in range(2):
+        params, opt, loss = step(params, opt, tok)
+    host_sync(params, loss)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        params, opt, loss = step(params, opt, tok)
+    host_sync(params, loss)
+    elapsed = time.perf_counter() - t0
+    tag = f"d{cfg.dim}x{cfg.depth}_s{seq}_b{batch}"
+    return batch * seq * steps / elapsed, float(loss), elapsed, tag
 
 
 def _enable_persistent_compile_cache(jax) -> None:
@@ -71,8 +128,29 @@ def main() -> None:
         shard_state,
     )
 
-    w = WORKLOADS[os.environ.get("BENCH_WORKLOAD", "lenet")]
+    name = os.environ.get("BENCH_WORKLOAD", "lenet")
+    w = WORKLOADS[name]
     n_dev = len(jax.devices())
+    if name == "lm":
+        steps = int(os.environ.get("BENCH_STEPS", 20))
+        tokens_per_sec, loss, elapsed, shape_tag = _bench_lm(steps)
+        assert np.isfinite(loss), f"non-finite loss {loss}"
+        print(
+            json.dumps(
+                {
+                    "metric": f"lm_{shape_tag}_train_tokens_per_sec",
+                    "value": round(tokens_per_sec, 1),
+                    "unit": "tokens/sec",
+                    "vs_baseline": round(tokens_per_sec / REF_IMAGES_PER_SEC, 2),
+                }
+            )
+        )
+        print(
+            f"# 1 device (1x1 mesh), {elapsed:.2f}s for {steps} LM steps, "
+            f"final loss {loss:.4f}",
+            file=sys.stderr,
+        )
+        return
     mesh = make_mesh(num_workers=n_dev)
     cfg = PSConfig(num_workers=n_dev, compress=w["compress"])
     model = build_model(w["network"])
