@@ -41,8 +41,15 @@ def _block_attend(q, k, v, mask, scale):
 
     q: [B, Tq, H, D]; k/v: [B, Tk, H, D]; mask: [Tq, Tk] bool or None.
     Returns (m_blk [B, H, Tq], p_sum [B, H, Tq], pv [B, Tq, H, D]).
+
+    Softmax statistics and accumulators are f32 regardless of input dtype
+    (bf16 stats lose the max-trick's cancellation; matmuls still run on
+    the inputs' dtype through the MXU with f32 accumulation).
     """
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    scores = (
+        jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+        * scale
+    )
     if mask is not None:
         scores = jnp.where(mask[None, None], scores, _NEG_BIG)
     m_blk = jnp.max(scores, axis=-1)  # [B, H, Tq]
@@ -51,7 +58,12 @@ def _block_attend(q, k, v, mask, scale):
         # rows with no valid key: m_blk == _NEG_BIG and p would be exp(0)=1
         p = jnp.where(mask[None, None], p, 0.0)
     p_sum = jnp.sum(p, axis=-1)
-    pv = jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    # PV runs on the inputs' dtype (bf16 MXU path) with f32 accumulation;
+    # only the stats (m, l) and the running output stay f32
+    pv = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
     return m_blk, p_sum, pv
 
 
@@ -102,9 +114,9 @@ def ring_attention(
         k_pos = k_blk * t_loc + jnp.arange(t_loc)
         return k_pos[None, :] <= q_pos[:, None]  # [Tq, Tk]
 
-    o0 = jnp.zeros_like(q)
-    m0 = jnp.full((b, h, t_loc), _NEG_BIG, q.dtype)
-    l0 = jnp.zeros((b, h, t_loc), q.dtype)
+    o0 = jnp.zeros(q.shape, jnp.float32)  # f32 accumulators (see _block_attend)
+    m0 = jnp.full((b, h, t_loc), _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros((b, h, t_loc), jnp.float32)
 
     # send my k/v block to the PREVIOUS device each hop: after s hops,
     # device i holds key block (i + s) mod n
@@ -167,7 +179,7 @@ def ring_attention(
             (jnp.arange(1, n_hops + 1), jnp.asarray(use_bwd)),
         )
     # causal guarantees >= 1 valid key per query (its own position), so l > 0
-    return o / l.transpose(0, 2, 1)[..., None]
+    return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
 def full_attention(
@@ -178,13 +190,21 @@ def full_attention(
     d = q.shape[-1]
     if scale is None:
         scale = 1.0 / (d ** 0.5)
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k) * scale
+    # f32 softmax regardless of input dtype (matches the ring/flash paths)
+    scores = (
+        jnp.einsum("bqhd,bkhd->bhqk", q, k, preferred_element_type=jnp.float32)
+        * scale
+    )
     if causal:
         t = q.shape[1]
         mask = jnp.tril(jnp.ones((t, t), bool))
         scores = jnp.where(mask[None, None], scores, _NEG_BIG)
     p = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v.dtype), v,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
 
 
 def make_seq_mesh(num_shards: Optional[int] = None) -> Mesh:

@@ -235,3 +235,27 @@ def test_remat_transformer_matches_and_trains(seq_mesh):
     np.testing.assert_allclose(float(l0), float(l2), rtol=1e-5)
     for a, b in zip(jax.tree_util.tree_leaves(g0), jax.tree_util.tree_leaves(g2)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=5e-4, atol=5e-5)
+
+
+def test_bf16_inputs_keep_f32_statistics(seq_mesh):
+    """bf16 q/k/v: output is bf16 but tracks the f32 oracle closely — the
+    softmax stats/accumulators must not degrade to bf16 (a bf16 running
+    max/denominator visibly corrupts long-sequence attention)."""
+    q, k, v = _qkv(seed=7)
+    qb, kb, vb = (x.astype(jnp.bfloat16) for x in (q, k, v))
+    out16 = full_attention(qb, kb, vb, causal=True)
+    assert out16.dtype == jnp.bfloat16
+    out32 = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        np.asarray(out16, np.float32), np.asarray(out32), atol=0.03
+    )
+
+    ring16 = make_ring_attention(seq_mesh, causal=True)(
+        shard_sequence(qb, seq_mesh),
+        shard_sequence(kb, seq_mesh),
+        shard_sequence(vb, seq_mesh),
+    )
+    assert ring16.dtype == jnp.bfloat16
+    np.testing.assert_allclose(
+        np.asarray(ring16, np.float32), np.asarray(out32), atol=0.03
+    )
