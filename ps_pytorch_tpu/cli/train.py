@@ -42,8 +42,15 @@ def main(argv=None) -> dict:
     tcfg = train_config_from(args)
     pcfg = ps_config_from(args, num_workers)
     trainer = Trainer(tcfg, pcfg)
+    # SIGTERM/SIGINT -> checkpoint + clean exit; rerun with --resume
+    trainer.install_signal_handlers()
     metrics = trainer.train()
     logger.info("training done: %s", metrics)
+    if trainer.stop_requested:
+        # preemption path: the checkpoint is written — exit before the
+        # grace window closes instead of starting a full validation pass
+        logger.warning("stopped by signal: skipping validation")
+        return {"train": metrics, "val": None}
     val = trainer.validate()
     return {"train": metrics, "val": val}
 

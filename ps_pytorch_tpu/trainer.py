@@ -115,6 +115,7 @@ class Trainer:
 
     def __init__(self, tcfg: TrainConfig, pcfg: PSConfig, dataset: Optional[Dataset] = None):
         self.tcfg, self.pcfg = tcfg, pcfg
+        self._stop_requested = False
         self.dataset = dataset or prepare_data(
             tcfg.dataset, root=tcfg.data_root, allow_synthetic=tcfg.allow_synthetic
         )
@@ -182,9 +183,53 @@ class Trainer:
         logger.info("resumed from %s", ckpt.checkpoint_path(self.tcfg.train_dir, step))
         return step
 
+    # ------------------------------------------------------------ graceful stop
+    def request_stop(self) -> None:
+        """Ask the training loop to stop after the current step (and write
+        a final checkpoint). Safe from signal handlers/threads."""
+        self._stop_requested = True
+
+    @property
+    def stop_requested(self) -> bool:
+        return self._stop_requested
+
+    def install_signal_handlers(self) -> None:
+        """SIGTERM/SIGINT -> graceful stop: finish the step, checkpoint,
+        return — so a preempted run resumes exactly with --resume. (The
+        reference's only recovery is killall + restart from step 1.)
+        Call from the main thread; second signal falls back to the
+        default handler (hard kill).
+
+        Single-process only: in a multi-host job a one-host stop would
+        desert the other hosts' collectives mid-step (deadlock until the
+        scheduler hard-kills everyone), so multi-process runs keep the
+        default signal behavior until a mesh-wide consensus stop exists."""
+        import signal
+
+        if jax.process_count() > 1:
+            logger.warning(
+                "graceful signal handling disabled: %d processes (a "
+                "one-host stop would deadlock the mesh collectives)",
+                jax.process_count(),
+            )
+            return
+
+        def handler(signum, frame):
+            logger.warning(
+                "signal %d: stopping after current step (next one kills)",
+                signum,
+            )
+            self.request_stop()
+            signal.signal(signum, signal.SIG_DFL)
+
+        signal.signal(signal.SIGTERM, handler)
+        signal.signal(signal.SIGINT, handler)
+
     # ------------------------------------------------------------------- train
     def train(self) -> dict:
-        """Run up to epochs/max_steps. Returns final metrics."""
+        """Run up to epochs/max_steps. Returns final metrics. A stop
+        requested BEFORE the loop starts (signal during setup) is honored
+        at the first step — never silently cleared."""
         t = self.tcfg
         if t.resume:
             self.try_resume()
@@ -307,6 +352,13 @@ class Trainer:
                         )
                         last_saved = step_no
                     if step_no >= t.max_steps:
+                        done = True
+                        break
+                    if self._stop_requested:
+                        logger.warning(
+                            "graceful stop at step %d (resume with --resume)",
+                            step_no,
+                        )
                         done = True
                         break
             if t.save_checkpoints and metrics and last_saved != step_no:

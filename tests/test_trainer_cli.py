@@ -392,3 +392,35 @@ def test_cli_train_lm_adam_cosine_bf16():
     )
     assert np.isfinite(out["loss"])
     assert out["loss"] < 3.2, out  # beats uniform log(32)=3.47 in 25 steps
+
+
+def test_graceful_stop_checkpoints_and_resumes(tmp_path, tiny_ds):
+    """request_stop mid-run -> final checkpoint at the stopped step; a
+    --resume run finishes the remaining steps (preemption recovery the
+    reference lacks: its only story is killall + restart from step 1).
+
+    The stop fires deterministically from inside the 5th train step (a
+    wall-clock timer could miss the run entirely on a fast machine)."""
+    tcfg = _tcfg(tmp_path, max_steps=50, eval_freq=100, log_interval=100,
+                 epochs=10)
+    pcfg = PSConfig(num_workers=2)
+    tr = Trainer(tcfg, pcfg, dataset=tiny_ds)
+    orig_step, calls = tr._train_step, {"n": 0}
+
+    def stopping_step(*a, **kw):
+        calls["n"] += 1
+        if calls["n"] == 5:
+            tr.request_stop()
+        return orig_step(*a, **kw)
+
+    tr._train_step = stopping_step
+    tr.train()
+    stopped_at = int(jax.device_get(tr.state.step))
+    assert stopped_at == 5, stopped_at  # stopped early, not at max
+    assert ckpt.latest_step(tcfg.train_dir) == stopped_at
+
+    tcfg2 = _tcfg(tmp_path, max_steps=stopped_at + 2, eval_freq=100,
+                  log_interval=100, resume=True)
+    tr2 = Trainer(tcfg2, pcfg, dataset=tiny_ds)
+    tr2.train()
+    assert int(jax.device_get(tr2.state.step)) == stopped_at + 2
