@@ -90,6 +90,7 @@ def evaluate_checkpoint(model_dir: str, step: int, eval_size: int = 64,
         moe = MoEConfig(
             num_experts=int(m["num_experts"]),
             capacity_factor=float(m["capacity_factor"]),
+            top_k=int(m.get("top_k", 1)),
         )
     else:
         moe = None

@@ -51,6 +51,12 @@ def main(argv=None) -> dict:
         # grace window closes instead of starting a full validation pass
         logger.warning("stopped by signal: skipping validation")
         return {"train": metrics, "val": None}
+    # restore default signal behavior: Ctrl-C during validation should
+    # interrupt it normally, not set a flag nothing reads anymore
+    import signal
+
+    signal.signal(signal.SIGTERM, signal.SIG_DFL)
+    signal.signal(signal.SIGINT, signal.default_int_handler)
     val = trainer.validate()
     return {"train": metrics, "val": val}
 

@@ -112,6 +112,8 @@ def main(argv=None) -> dict:
                         help="moe only: total experts")
     parser.add_argument("--capacity-factor", type=float, default=1.25,
                         help="moe only: expert capacity factor")
+    parser.add_argument("--top-k", type=int, default=1, choices=(1, 2),
+                        help="moe only: 1 = Switch, 2 = GShard routing")
     parser.add_argument("--train-size", type=int, default=512,
                         help="synthetic corpus size (sequences)")
     parser.add_argument("--metrics-file", type=str, default=None)
@@ -255,7 +257,9 @@ def main(argv=None) -> dict:
             )
         mesh = make_ep_mesh(n_shards)
         moe = MoEConfig(
-            num_experts=args.num_experts, capacity_factor=args.capacity_factor
+            num_experts=args.num_experts,
+            capacity_factor=args.capacity_factor,
+            top_k=args.top_k,
         )
         params, opt_state = init_moe_state(cfg, moe, tx, key, mesh)
         moe_step = make_moe_train_step(cfg, moe, tx, mesh)
@@ -300,6 +304,7 @@ def main(argv=None) -> dict:
                     "max_seq_len": cfg.max_seq_len,
                     "num_experts": args.num_experts,
                     "capacity_factor": float(args.capacity_factor),
+                    "top_k": args.top_k,
                 },
                 "data": {"seed": args.seed + 1, "seq_len": args.seq_len},
             },

@@ -54,6 +54,13 @@ class MoEConfig:
     num_experts: int = 8
     capacity_factor: float = 1.25
     aux_loss_weight: float = 0.01
+    # 1 = Switch routing; 2 = GShard-style top-2 (renormalized gates,
+    # second choices queue behind first choices for capacity slots)
+    top_k: int = 1
+
+    def __post_init__(self):
+        if self.top_k not in (1, 2):
+            raise ValueError(f"top_k must be 1 or 2, got {self.top_k}")
 
 
 def make_ep_mesh(
@@ -122,26 +129,59 @@ def shard_params_moe(
     return place_on_mesh(params, mesh, moe_param_specs(cfg, axis))
 
 
-def _gate_and_dispatch(x2d, wg, capacity):
-    """Top-1 gating over flat tokens [N, D].
+def _choice_dispatch(onehot, capacity, offset):
+    """Queue one routing choice into capacity slots.
+
+    onehot [N, E]; offset [E] = slots already taken per expert by earlier
+    (higher-priority) choices. Returns the [N, E, C] dispatch tensor
+    (1.0 where a token owns a slot; overflow rows are all-zero).
+    """
+    rank = jnp.cumsum(onehot, axis=0) * onehot - onehot  # [N, E] within-choice
+    rank = rank + offset[None, :] * onehot
+    kept = (rank < capacity) * onehot
+    pos = jax.nn.one_hot(
+        jnp.sum(rank * onehot, axis=-1), capacity, dtype=jnp.float32
+    )  # [N, C]
+    return kept[:, :, None] * pos[:, None, :]
+
+
+def _gate_and_dispatch(x2d, wg, capacity, top_k: int = 1):
+    """Top-1 (Switch) or top-2 (GShard) gating over flat tokens [N, D].
 
     Returns (dispatch [N, E, C] float {0,1}, combine [N, E, C], aux scalar).
+    For top-2, gates are renormalized over the two choices and second
+    choices queue behind ALL first choices for an expert's capacity slots.
     """
     logits = x2d @ wg  # [N, E]
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
-    expert = jnp.argmax(probs, axis=-1)  # [N]
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]
     e = wg.shape[-1]
-    onehot = jax.nn.one_hot(expert, e, dtype=jnp.float32)  # [N, E]
-    # position of each token within its expert's queue (0-based)
-    rank = jnp.cumsum(onehot, axis=0) * onehot - onehot  # [N, E]
-    kept = (rank < capacity) * onehot  # drop overflow
-    pos = jax.nn.one_hot(jnp.sum(rank * onehot, axis=-1), capacity,
-                         dtype=jnp.float32)  # [N, C]
-    dispatch = kept[:, :, None] * pos[:, None, :]  # [N, E, C]
-    combine = dispatch * gate[:, None, None]
-    # Switch aux loss: E * sum_e (fraction routed to e) * (mean prob of e)
-    f = jnp.mean(onehot, axis=0)
+
+    expert1 = jnp.argmax(probs, axis=-1)  # [N]
+    gate1 = jnp.take_along_axis(probs, expert1[:, None], axis=-1)[:, 0]
+    onehot1 = jax.nn.one_hot(expert1, e, dtype=jnp.float32)  # [N, E]
+    dispatch = _choice_dispatch(onehot1, capacity, jnp.zeros((e,)))  # [N,E,C]
+
+    if top_k == 2:
+        probs2 = probs * (1.0 - onehot1)  # mask the first choice
+        expert2 = jnp.argmax(probs2, axis=-1)
+        gate2 = jnp.take_along_axis(probs, expert2[:, None], axis=-1)[:, 0]
+        onehot2 = jax.nn.one_hot(expert2, e, dtype=jnp.float32)
+        # second choices queue behind every first choice (capped at C)
+        taken = jnp.minimum(jnp.sum(onehot1, axis=0), capacity)
+        dispatch2 = _choice_dispatch(onehot2, capacity, taken)
+        # renormalize over the two choices (dropped choices contribute 0)
+        denom = gate1 + gate2 + 1e-9
+        combine = (
+            dispatch * (gate1 / denom)[:, None, None]
+            + dispatch2 * (gate2 / denom)[:, None, None]
+        )
+        dispatch = dispatch + dispatch2
+    else:
+        combine = dispatch * gate1[:, None, None]
+
+    # aux load-balance loss on first-choice assignment (Switch form):
+    # E * sum_e (fraction routed to e) * (mean prob of e)
+    f = jnp.mean(onehot1, axis=0)
     p = jnp.mean(probs, axis=0)
     aux = e * jnp.sum(f * p)
     return dispatch, combine, aux
@@ -157,10 +197,10 @@ def moe_mlp_local(h, blk, moe: MoEConfig, axis_name: Optional[str]):
     b, t, d = h.shape
     x2d = h.reshape(b * t, d)
     e = moe.num_experts
-    capacity = int(np.ceil(b * t * moe.capacity_factor / e))
+    capacity = int(np.ceil(b * t * moe.top_k * moe.capacity_factor / e))
     # cast at use: params may be stored f32 while activations run bf16
     dispatch, combine, aux = _gate_and_dispatch(
-        x2d, blk["wg"].astype(h.dtype), capacity
+        x2d, blk["wg"].astype(h.dtype), capacity, top_k=moe.top_k
     )
     # gating runs in f32; the dispatch/combine one-hots drop back to the
     # activation dtype so the expert matmuls stay on the bf16 path
