@@ -46,17 +46,15 @@ def main(argv=None) -> dict:
     trainer.install_signal_handlers()
     metrics = trainer.train()
     logger.info("training done: %s", metrics)
+    # past the loop the handlers' flag is no longer read: put the previous
+    # handlers back so Ctrl-C during validation (or in an embedding app)
+    # behaves normally again
+    trainer.restore_signal_handlers()
     if trainer.stop_requested:
         # preemption path: the checkpoint is written — exit before the
         # grace window closes instead of starting a full validation pass
         logger.warning("stopped by signal: skipping validation")
         return {"train": metrics, "val": None}
-    # restore default signal behavior: Ctrl-C during validation should
-    # interrupt it normally, not set a flag nothing reads anymore
-    import signal
-
-    signal.signal(signal.SIGTERM, signal.SIG_DFL)
-    signal.signal(signal.SIGINT, signal.default_int_handler)
     val = trainer.validate()
     return {"train": metrics, "val": val}
 

@@ -49,6 +49,55 @@ def score_lines(lines, window: int) -> float:
     return sum(losses[-window:]) / len(losses[-window:])
 
 
+def _sweep(run_one, lr_grid, window) -> dict:
+    """Shared grid loop: capture each run's iteration log lines, score
+    through the reference's log-parsing semantics, print the ranking."""
+    results = {}
+    for lr in lr_grid:
+        capture = _LineCapture()
+        logger.addHandler(capture)
+        try:
+            run_one(lr)
+        finally:
+            logger.removeHandler(capture)
+        results[lr] = score_lines(capture.lines, window)
+        logger.info("lr %g -> mean loss %.4f", lr, results[lr])
+    ranking = sorted(results.items(), key=lambda kv: kv[1])
+    logger.info("best lr: %g (mean loss %.4f)", *ranking[0])
+    return results
+
+
+def tune_lm(args) -> dict:
+    """LR sweep over cli.train_lm (any --parallelism scheme): each grid
+    point is a fresh short run scored through the same log-parsing path
+    the CNN sweep (and the reference's tiny_tuning_parser) uses. The
+    shared training flags (optimizer, weight decay, dtype) forward."""
+    from .train_lm import main as lm_main
+
+    def run_one(lr):
+        lm_main(
+            [
+                "--parallelism", args.lm_parallelism,
+                "--seq-len", str(args.lm_seq_len),
+                "--dim", str(args.lm_dim),
+                "--depth", str(args.lm_depth),
+                "--heads", str(args.lm_heads),
+                "--vocab-size", str(args.lm_vocab_size),
+                "--max-steps", str(args.max_steps),
+                "--batch-size", str(args.batch_size),
+                "--log-interval", "1",
+                "--lr", str(lr),
+                "--seed", str(args.seed),
+                "--optimizer", args.optimizer,
+                "--momentum", str(args.momentum),
+                "--weight-decay", str(getattr(args, "weight_decay", 0.0)),
+                "--dtype", args.dtype,
+            ]
+        )
+
+    return _sweep(run_one, args.lr_grid, args.score_window)
+
+
 def main(argv=None) -> dict:
     parser = argparse.ArgumentParser("ps_pytorch_tpu.cli.tune")
     add_train_flags(parser)
@@ -57,33 +106,35 @@ def main(argv=None) -> dict:
                         default=list(DEFAULT_GRID))
     parser.add_argument("--score-window", type=int, default=10,
                         help="average the loss over the final N logged steps")
+    parser.add_argument("--workload", default="ps", choices=["ps", "lm"],
+                        help="ps: CNN PS trainer; lm: train_lm sweep")
+    parser.add_argument("--lm-parallelism", default="dp_sp")
+    parser.add_argument("--lm-seq-len", type=int, default=128)
+    parser.add_argument("--lm-dim", type=int, default=128)
+    parser.add_argument("--lm-depth", type=int, default=2)
+    parser.add_argument("--lm-heads", type=int, default=4)
+    parser.add_argument("--lm-vocab-size", type=int, default=64)
     args = parser.parse_args(argv)
+
+    if args.workload == "lm":
+        return tune_lm(args)
 
     num_workers = args.num_workers or len(jax.devices())
     base = train_config_from(args)
     dataset = prepare_data(
         base.dataset, root=base.data_root, allow_synthetic=base.allow_synthetic
     )  # load once; each grid point reuses it
-    results = {}
-    for lr in args.lr_grid:
+
+    def run_one(lr):
         tcfg = train_config_from(args)
         tcfg.lr = lr
         tcfg.log_interval = 1  # score every step
         tcfg.save_checkpoints = False
         tcfg.resume = False  # every candidate must start from scratch
         pcfg = ps_config_from(args, num_workers)
-        capture = _LineCapture()
-        logger.addHandler(capture)
-        try:
-            Trainer(tcfg, pcfg, dataset=dataset).train()
-        finally:
-            logger.removeHandler(capture)
-        results[lr] = score_lines(capture.lines, args.score_window)
-        logger.info("lr %g -> mean loss %.4f", lr, results[lr])
+        Trainer(tcfg, pcfg, dataset=dataset).train()
 
-    ranking = sorted(results.items(), key=lambda kv: kv[1])
-    logger.info("best lr: %g (mean loss %.4f)", *ranking[0])
-    return results
+    return _sweep(run_one, args.lr_grid, args.score_window)
 
 
 if __name__ == "__main__":

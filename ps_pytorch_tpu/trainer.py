@@ -222,8 +222,19 @@ class Trainer:
             self.request_stop()
             signal.signal(signum, signal.SIG_DFL)
 
-        signal.signal(signal.SIGTERM, handler)
-        signal.signal(signal.SIGINT, handler)
+        self._prev_handlers = {
+            signal.SIGTERM: signal.signal(signal.SIGTERM, handler),
+            signal.SIGINT: signal.signal(signal.SIGINT, handler),
+        }
+
+    def restore_signal_handlers(self) -> None:
+        """Put back whatever handlers were installed before
+        install_signal_handlers (embedding applications keep theirs)."""
+        import signal
+
+        for signum, prev in getattr(self, "_prev_handlers", {}).items():
+            signal.signal(signum, prev)
+        self._prev_handlers = {}
 
     # ------------------------------------------------------------------- train
     def train(self) -> dict:

@@ -424,3 +424,20 @@ def test_graceful_stop_checkpoints_and_resumes(tmp_path, tiny_ds):
     tr2 = Trainer(tcfg2, pcfg, dataset=tiny_ds)
     tr2.train()
     assert int(jax.device_get(tr2.state.step)) == stopped_at + 2
+
+
+def test_cli_tune_lm(monkeypatch):
+    from ps_pytorch_tpu.cli.tune import main
+
+    out = main(
+        [
+            "--workload", "lm", "--lm-parallelism", "tp", "--lm-heads", "8",
+            "--lm-dim", "64", "--lm-seq-len", "32", "--lm-vocab-size", "32",
+            "--lr-grid", "0.2", "0.001", "--max-steps", "10",
+            "--batch-size", "8", "--score-window", "4",
+        ]
+    )
+    assert set(out) == {0.2, 0.001}
+    assert all(np.isfinite(v) for v in out.values())
+    # the aggressive lr learns visibly more in 10 steps on the Markov chain
+    assert out[0.2] < out[0.001]
