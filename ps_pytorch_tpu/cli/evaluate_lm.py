@@ -105,28 +105,25 @@ def evaluate_checkpoint(model_dir: str, step: int, eval_size: int = 64,
     out = {"step": step, "loss": nll, "perplexity": math.exp(nll)}
 
     if generate_tokens > 0:
-        if m["kind"] == "moe":
-            logger.info("generation: MoE checkpoints have no decode path yet")
-        else:
-            from ..models.decode import generate
+        from ..models.decode import generate
 
-            prompt = jnp.asarray(toks[:2, : min(8, seq_len // 2)])
-            # clamp to the model's positional range (never crash the
-            # long-running polling process over a sampling nicety)
-            n_new = min(generate_tokens, cfg.max_seq_len - prompt.shape[1])
-            if n_new < generate_tokens:
-                logger.info(
-                    "generation: clamping %d -> %d tokens (max_seq_len %d)",
-                    generate_tokens, n_new, cfg.max_seq_len,
-                )
-            sample = generate(
-                cfg, params, prompt, max_new_tokens=n_new,
-                temperature=0.8, key=jax.random.key(step),
-                max_len=prompt.shape[1] + n_new,
+        prompt = jnp.asarray(toks[:2, : min(8, seq_len // 2)])
+        # clamp to the model's positional range (never crash the
+        # long-running polling process over a sampling nicety)
+        n_new = min(generate_tokens, cfg.max_seq_len - prompt.shape[1])
+        if n_new < generate_tokens:
+            logger.info(
+                "generation: clamping %d -> %d tokens (max_seq_len %d)",
+                generate_tokens, n_new, cfg.max_seq_len,
             )
-            out["samples"] = np.asarray(sample).tolist()
-            for row in out["samples"]:
-                logger.info("sample: %s", " ".join(map(str, row)))
+        sample = generate(
+            cfg, params, prompt, max_new_tokens=n_new,
+            temperature=0.8, key=jax.random.key(step),
+            max_len=prompt.shape[1] + n_new, moe=moe,
+        )
+        out["samples"] = np.asarray(sample).tolist()
+        for row in out["samples"]:
+            logger.info("sample: %s", " ".join(map(str, row)))
     return out
 
 

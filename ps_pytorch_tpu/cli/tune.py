@@ -90,7 +90,7 @@ def tune_lm(args) -> dict:
                 "--seed", str(args.seed),
                 "--optimizer", args.optimizer,
                 "--momentum", str(args.momentum),
-                "--weight-decay", str(getattr(args, "weight_decay", 0.0)),
+                "--weight-decay", str(args.weight_decay),
                 "--dtype", args.dtype,
             ]
         )

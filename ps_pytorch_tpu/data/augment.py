@@ -10,7 +10,6 @@ augmentation rides the accelerator instead of Python workers
 from __future__ import annotations
 
 from functools import partial
-from typing import Tuple
 
 import jax
 import jax.numpy as jnp

@@ -19,7 +19,7 @@ from __future__ import annotations
 
 import collections
 import ctypes
-from typing import Iterator, Optional
+from typing import Iterator
 
 import jax
 import numpy as np

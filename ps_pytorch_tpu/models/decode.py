@@ -54,14 +54,17 @@ def _attend_cached(q, k_cache, v_cache, length, scale):
 
 
 def _decode_one(cfg: TransformerConfig, params: Dict, cache: Dict,
-                token: jax.Array, pos: jax.Array) -> Tuple[jax.Array, Dict]:
+                token: jax.Array, pos: jax.Array,
+                moe=None) -> Tuple[jax.Array, Dict]:
     """One token [B] at position pos -> (logits [B, V], updated cache).
 
     Block math comes from transformer_block (the single source — training
     and decoding cannot diverge); only `attend` differs: it writes this
     step's K/V into the stacked cache IN PLACE (one [depth,B,L,H,hd]
     dynamic_update_slice per block, no full-cache re-stack) and attends
-    over the valid prefix.
+    over the valid prefix. With `moe` (a MoEConfig), the block's MLP is
+    the all-experts-local MoE mixture (single-device decode; capacity is
+    made roomy so no decode token is ever dropped).
     """
     from .transformer import transformer_block
 
@@ -70,6 +73,13 @@ def _decode_one(cfg: TransformerConfig, params: Dict, cache: Dict,
     x = x[:, None]  # [B, 1, D]
     scale = 1.0 / (cfg.head_dim ** 0.5)
     k_buf, v_buf = cache["k"], cache["v"]
+
+    roomy = None
+    if moe is not None:
+        import dataclasses as _dc
+
+        # roomy capacity: B tokens/step must never drop in decode
+        roomy = _dc.replace(moe, capacity_factor=float(moe.num_experts))
 
     for i, blk in enumerate(params["blocks"]):
 
@@ -83,7 +93,15 @@ def _decode_one(cfg: TransformerConfig, params: Dict, cache: Dict,
             )
             return _attend_cached(q, k_buf[_i], v_buf[_i], pos + 1, scale)
 
-        x = transformer_block(cfg, x, blk, attend)
+        mlp = None
+        if roomy is not None:
+            from ..parallel.moe import moe_mlp_local
+
+            def mlp(h, _blk=blk):
+                out, _aux = moe_mlp_local(h, _blk, roomy, None)
+                return out
+
+        x = transformer_block(cfg, x, blk, attend, mlp=mlp)
 
     cache = {"k": k_buf, "v": v_buf}
     xf = _rms_norm(x[:, 0].astype(cd), params["out_norm"].astype(cd))
@@ -99,8 +117,11 @@ def generate(
     temperature: float = 0.0,
     key: Optional[jax.Array] = None,
     max_len: Optional[int] = None,
+    moe=None,
 ) -> jax.Array:
     """Generate greedily (temperature=0) or by temperature sampling.
+    Pass `moe` (a MoEConfig) to decode a MoE checkpoint (all experts
+    local, no-drop capacity).
 
     Returns int32 [B, T_prompt + max_new_tokens]. The prompt is prefilled
     through the same single-token decode path inside one scan (simple and
@@ -123,7 +144,7 @@ def generate(
     def step(carry, pos):
         buf, cache, k = carry
         token = buf[:, pos]  # current input token
-        logits, cache = _decode_one(cfg, params, cache, token, pos)
+        logits, cache = _decode_one(cfg, params, cache, token, pos, moe=moe)
         k, ks = jax.random.split(k)
         if temperature > 0:
             nxt = jax.random.categorical(ks, logits / temperature, axis=-1)

@@ -233,7 +233,13 @@ class Trainer:
         import signal
 
         for signum, prev in getattr(self, "_prev_handlers", {}).items():
-            signal.signal(signum, prev)
+            if prev is None:
+                # prior handler was installed from C (signal.signal
+                # returned None) — we cannot re-install it; leave ours
+                # replaced by the safe default instead of raising
+                signal.signal(signum, signal.SIG_DFL)
+            else:
+                signal.signal(signum, prev)
         self._prev_handlers = {}
 
     # ------------------------------------------------------------------- train

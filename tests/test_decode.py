@@ -113,3 +113,31 @@ def test_greedy_on_trained_lm_continues_the_chain():
     )
     total = 4 * 12
     assert valid / total > 0.5, f"only {valid}/{total} valid transitions"
+
+
+def test_moe_greedy_matches_full_forward():
+    """MoE decode (all experts local, roomy capacity) == greedy over the
+    full MoE forward, token by token."""
+    from ps_pytorch_tpu.parallel.moe import (
+        MoEConfig,
+        apply_moe_transformer,
+        init_moe_params,
+    )
+
+    cfg = TransformerConfig(vocab_size=23, dim=32, depth=2, heads=4,
+                            max_seq_len=24)
+    moe = MoEConfig(num_experts=4, capacity_factor=4.0)
+    params = init_moe_params(cfg, moe, jax.random.key(7))
+    rng = np.random.RandomState(7)
+    prompt = jnp.asarray(rng.randint(0, 23, (2, 4)), jnp.int32)
+
+    buf = np.asarray(prompt)
+    for _ in range(6):
+        logits, _ = apply_moe_transformer(cfg, moe, params, jnp.asarray(buf), None)
+        nxt = np.asarray(jnp.argmax(logits[:, -1], axis=-1))
+        buf = np.concatenate([buf, nxt[:, None].astype(np.int32)], axis=1)
+
+    got = np.asarray(
+        generate(cfg, params, prompt, max_new_tokens=6, moe=moe)
+    )
+    np.testing.assert_array_equal(got, buf)
