@@ -140,7 +140,7 @@ def main(argv=None) -> dict:
                    help="stop after this long with no new checkpoint")
     p.add_argument("--generate", type=int, default=0,
                    help="also sample N tokens from 2 held-out prompts "
-                        "(KV-cache decode; dense checkpoints only)")
+                        "(KV-cache decode; dense and MoE checkpoints)")
     args = p.parse_args(argv)
 
     results = {}

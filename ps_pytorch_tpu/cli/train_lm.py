@@ -117,6 +117,9 @@ def main(argv=None) -> dict:
     parser.add_argument("--train-size", type=int, default=512,
                         help="synthetic corpus size (sequences)")
     parser.add_argument("--metrics-file", type=str, default=None)
+    parser.add_argument("--profile-dir", type=str, default=None,
+                        help="write a jax.profiler device trace for steps "
+                             "3..12 (view with tensorboard/xprof)")
     parser.add_argument("--train-dir", type=str, default=None,
                         help="checkpoint dir (scheme-agnostic plain layout; "
                              "consumed by cli.evaluate_lm)")
@@ -314,7 +317,17 @@ def main(argv=None) -> dict:
 
     rng = np.random.RandomState(args.seed + 2)
     loss = float("nan")
+    profiling = False
+    profile_stop = min(12, args.max_steps)
+    if args.profile_dir and args.max_steps < 3:
+        logger.warning(
+            "--profile-dir set but max-steps < 3: tracing starts at step 3 "
+            "(after compile + settle), so no trace will be written"
+        )
     for step_no in range(1, args.max_steps + 1):
+        if args.profile_dir and step_no == 3:  # after compile + settle
+            jax.profiler.start_trace(args.profile_dir)
+            profiling = True
         log_now = step_no % args.log_interval == 0 or step_no == 1
         if log_now:
             # drain the async-dispatch backlog BEFORE starting the clock so
@@ -344,6 +357,11 @@ def main(argv=None) -> dict:
                 record["aux_loss"] = round(float(aux_box["aux"]), 6)
                 logger.info("MoE load-balance aux: %.4f", record["aux_loss"])
             append_metrics_line(args.metrics_file, record)
+        if profiling and step_no >= profile_stop:
+            host_sync(params)  # trace must contain retired work
+            jax.profiler.stop_trace()
+            profiling = False
+            logger.info("profiler trace written to %s", args.profile_dir)
         if args.eval_freq > 0 and step_no % args.eval_freq == 0:
             save_lm_checkpoint(step_no)
     if args.train_dir is not None and (

@@ -166,12 +166,14 @@ def generate(
 
 
 def make_generate(cfg: TransformerConfig, max_new_tokens: int,
-                  temperature: float = 0.0, max_len: Optional[int] = None):
-    """Jitted generate: (params, prompt [B, T], key) -> [B, T + new]."""
+                  temperature: float = 0.0, max_len: Optional[int] = None,
+                  moe=None):
+    """Jitted generate: (params, prompt [B, T], key) -> [B, T + new].
+    Pass `moe` for MoE checkpoints (same contract as generate)."""
     def fn(params, prompt, key):
         return generate(
             cfg, params, prompt, max_new_tokens,
-            temperature=temperature, key=key, max_len=max_len,
+            temperature=temperature, key=key, max_len=max_len, moe=moe,
         )
 
     return jax.jit(fn)
