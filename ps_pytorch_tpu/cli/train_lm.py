@@ -104,6 +104,9 @@ def main(argv=None) -> dict:
     parser.add_argument("--attention-impl", default="naive",
                         choices=["naive", "flash"],
                         help="within-chip attention kernel (flash = Pallas)")
+    parser.add_argument("--shard-vocab", action="store_true",
+                        help="tp only: vocab-parallel embedding + loss "
+                             "(full logits never materialize per device)")
     parser.add_argument("--num-shards", type=int, default=0,
                         help="tp/pp/moe axis size (0 = all devices)")
     parser.add_argument("--num-microbatches", type=int, default=2,
@@ -127,6 +130,12 @@ def main(argv=None) -> dict:
                         help="checkpoint every N steps (0 = only at the end)")
     args = parser.parse_args(argv)
 
+    if args.shard_vocab and args.parallelism != "tp":
+        raise ValueError(
+            "--shard-vocab is implemented for --parallelism tp only (the "
+            "other schemes keep the embedding replicated and would silently "
+            "ignore it)"
+        )
     if args.attention_impl == "flash" and args.parallelism == "dp_sp":
         raise ValueError(
             "--attention-impl flash applies to the within-chip attention of "
@@ -199,11 +208,13 @@ def main(argv=None) -> dict:
         )
 
         mesh = make_tp_mesh(n_shards)
-        params, opt_state = init_tp_state(cfg, tx, key, mesh)
-        step = make_tp_train_step(cfg, tx, mesh)
+        params, opt_state = init_tp_state(
+            cfg, tx, key, mesh, shard_vocab=args.shard_vocab
+        )
+        step = make_tp_train_step(cfg, tx, mesh, shard_vocab=args.shard_vocab)
         run = lambda p, o, tok: step(p, o, jnp.asarray(tok))
         to_plain = lambda p: from_tp_layout(cfg, p)
-        layout = f"tp {n_shards}"
+        layout = f"tp {n_shards}" + (" (vocab-parallel)" if args.shard_vocab else "")
     elif args.parallelism == "dp_tp":
         from ..parallel.dp_tp import (
             init_dp_tp_state,

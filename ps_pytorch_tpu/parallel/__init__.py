@@ -64,6 +64,7 @@ from .tp import (
     shard_params_tp,
     to_tp_layout,
     tp_param_specs,
+    vocab_parallel_nll,
 )
 from .ulysses import (
     make_ulysses_attention,

@@ -11,8 +11,13 @@ the standard Megatron split mapped onto XLA collectives:
   (row-parallel) and the output projection ends in one `psum`.
 - MLP: `w_up` column-sharded [D, M/n] (independent GELUs), `w_down`
   row-sharded [M/n, D], one `psum` after the down-projection.
-- embeddings / norms / logits: replicated (vocab is small in the
-  reference-scale configs; sharding the embedding is a future axis).
+- embeddings: replicated by default; `shard_vocab=True` shards the
+  embedding matrix [V, D] over the model axis (vocab-parallel): the
+  lookup masks out-of-range ids and psums partial embeddings, and the
+  unembedding keeps logits LOCAL [B, T, V/n] — the cross-entropy runs
+  vocab-parallel (gathered row max + psum'd exp-sum plus the owner
+  shard's target logit) so the full [B, T, V] tensor never exists on
+  any device. Norms stay replicated.
 
 Two psums per block per token — both ride ICI, both fused by XLA into the
 surrounding matmuls. Gradients w.r.t. sharded weights are naturally local
@@ -88,7 +93,9 @@ def from_tp_layout(cfg: TransformerConfig, params_tp: Dict) -> Dict:
     return out
 
 
-def tp_param_specs(cfg: TransformerConfig, axis: str = TP_AXIS) -> Dict:
+def tp_param_specs(
+    cfg: TransformerConfig, axis: str = TP_AXIS, shard_vocab: bool = False
+) -> Dict:
     """PartitionSpec pytree matching `to_tp_layout` output."""
     blk = {
         "ln1": P(),
@@ -99,7 +106,7 @@ def tp_param_specs(cfg: TransformerConfig, axis: str = TP_AXIS) -> Dict:
         "w_down": P(axis, None),
     }
     return {
-        "embed": P(),
+        "embed": P(axis, None) if shard_vocab else P(),
         "pos_embed": P(),
         "out_norm": P(),
         "blocks": [dict(blk) for _ in range(cfg.depth)],
@@ -107,7 +114,8 @@ def tp_param_specs(cfg: TransformerConfig, axis: str = TP_AXIS) -> Dict:
 
 
 def shard_params_tp(
-    cfg: TransformerConfig, params_tp: Dict, mesh: Mesh, axis: str = TP_AXIS
+    cfg: TransformerConfig, params_tp: Dict, mesh: Mesh, axis: str = TP_AXIS,
+    shard_vocab: bool = False,
 ) -> Dict:
     """Place a TP-layout param tree on the mesh with the TP shardings."""
     n = mesh.shape[axis]
@@ -117,9 +125,13 @@ def shard_params_tp(
         raise ValueError(
             f"mlp dim {cfg.dim * cfg.mlp_ratio} not divisible by {n} model shards"
         )
+    if shard_vocab and cfg.vocab_size % n:
+        raise ValueError(
+            f"vocab {cfg.vocab_size} not divisible by {n} model shards"
+        )
     from .mesh import place_on_mesh
 
-    return place_on_mesh(params_tp, mesh, tp_param_specs(cfg, axis))
+    return place_on_mesh(params_tp, mesh, tp_param_specs(cfg, axis, shard_vocab))
 
 
 def apply_transformer_tp(
@@ -127,8 +139,13 @@ def apply_transformer_tp(
     params: Dict,  # TP layout, LOCAL shards (inside shard_map)
     tokens: jax.Array,  # int32 [B, T] (replicated)
     axis_name: str = TP_AXIS,
+    shard_vocab: bool = False,
 ) -> jax.Array:
-    """Forward on one model shard -> replicated logits [B, T, vocab].
+    """Forward on one model shard.
+
+    Returns replicated logits [B, T, vocab] (shard_vocab=False), or the
+    LOCAL logits shard [B, T, vocab/n] (shard_vocab=True — feed to
+    vocab_parallel_nll; the full logits tensor never materializes).
 
     Mirrors models/transformer.py:apply_transformer with the Megatron
     split; every activation entering/leaving a block is replicated, so the
@@ -140,7 +157,17 @@ def apply_transformer_tp(
     attend_local = local_attention(cfg)
     b, t = tokens.shape
     pos = jnp.arange(t)
-    x = params["embed"][tokens] + params["pos_embed"][pos][None]
+    if shard_vocab:
+        # vocab-parallel lookup: my shard owns ids [off, off + v_loc);
+        # out-of-range rows contribute zero, psum completes the embedding
+        v_loc = params["embed"].shape[0]
+        off = lax.axis_index(axis_name) * v_loc
+        local_ids = jnp.clip(tokens - off, 0, v_loc - 1)
+        mine = (tokens >= off) & (tokens < off + v_loc)
+        emb = jnp.where(mine[..., None], params["embed"][local_ids], 0.0)
+        x = lax.psum(emb, axis_name) + params["pos_embed"][pos][None]
+    else:
+        x = params["embed"][tokens] + params["pos_embed"][pos][None]
 
     cd = cfg.effective_compute_dtype
 
@@ -162,7 +189,45 @@ def apply_transformer_tp(
     for blk in params["blocks"]:
         x = block(x, blk)
     xf = _rms_norm(x.astype(cd), params["out_norm"].astype(cd))
+    # tied unembedding: local vocab columns only when sharded
     return xf @ params["embed"].T.astype(cd)
+
+
+def vocab_parallel_nll(
+    logits_local: jax.Array,  # [B, T, V/n] — this shard's vocab columns
+    tokens: jax.Array,  # int32 [B, T] (replicated)
+    axis_name: str = TP_AXIS,
+) -> jax.Array:
+    """Mean next-token NLL over vocab-sharded logits (Megatron-style).
+
+    softmax statistics cross the mesh per position as the row max (an
+    all_gather of n scalars + max — pmax has no JVP rule) and a psum'd
+    exp-sum, plus the owner shard's target logit — the full [B, T, V]
+    logits tensor never exists on any device.
+    Matches ops/metrics.next_token_nll on gathered logits exactly (up to
+    reduction order); tested in tests/test_tp.py.
+    """
+    lg = logits_local[:, :-1].astype(jnp.float32)  # positions predicting t+1
+    tgt = tokens[:, 1:]
+    v_loc = lg.shape[-1]
+    off = lax.axis_index(axis_name) * v_loc
+
+    # global row max, for stability only: its gradient cancels analytically
+    # in m + log(sum exp(lg - m)), so stop_gradient is EXACT. pmax has no
+    # JVP rule at all (even under stop_gradient the trace hits it), so the
+    # max crosses the mesh as all_gather + max, which differentiates fine.
+    m = lax.stop_gradient(
+        jnp.max(lax.all_gather(jnp.max(lg, axis=-1), axis_name), axis=0)
+    )
+    z = lax.psum(jnp.sum(jnp.exp(lg - m[..., None]), axis=-1), axis_name)
+
+    local_tgt = jnp.clip(tgt - off, 0, v_loc - 1)
+    mine = (tgt >= off) & (tgt < off + v_loc)
+    picked = jnp.take_along_axis(lg, local_tgt[..., None], axis=-1)[..., 0]
+    tgt_logit = lax.psum(jnp.where(mine, picked, 0.0), axis_name)
+
+    # log softmax(target) = tgt_logit - m - log z
+    return jnp.mean(m + jnp.log(z) - tgt_logit)
 
 
 def make_tp_forward(
@@ -224,18 +289,22 @@ def init_tp_state(
     key: jax.Array,
     mesh: Mesh,
     axis_name: str = TP_AXIS,
+    shard_vocab: bool = False,
 ):
     """Init (params_tp, opt_state) already placed with TP shardings —
     momentum buffers shard exactly like their parameters."""
     from ..models.transformer import init_transformer
 
     params_tp = shard_params_tp(
-        cfg, to_tp_layout(cfg, init_transformer(cfg, key)), mesh, axis_name
+        cfg, to_tp_layout(cfg, init_transformer(cfg, key)), mesh, axis_name,
+        shard_vocab=shard_vocab,
     )
     from .mesh import place_on_mesh
 
     opt_state = tx.init(params_tp)
-    specs = opt_state_specs(opt_state, params_tp, tp_param_specs(cfg, axis_name))
+    specs = opt_state_specs(
+        opt_state, params_tp, tp_param_specs(cfg, axis_name, shard_vocab)
+    )
     return params_tp, place_on_mesh(opt_state, mesh, specs)
 
 
@@ -245,26 +314,33 @@ def make_tp_train_step(
     mesh: Mesh,
     axis_name: str = TP_AXIS,
     donate: bool = True,
+    shard_vocab: bool = False,
 ):
     """Jitted TP LM train step: (params_tp, opt_state, tokens) ->
     (params_tp, opt_state, loss). Params/opt state sharded over the model
     axis; tokens replicated. Gradients for sharded weights are local, so
     the optimizer update is shard-wise — no gradient collective at all
-    (the two in-block psums are the only communication)."""
+    (the two in-block psums are the only communication). With
+    shard_vocab=True the embedding/logits run vocab-parallel (see
+    vocab_parallel_nll)."""
 
-    specs_tree = tp_param_specs(cfg, axis_name)
+    specs_tree = tp_param_specs(cfg, axis_name, shard_vocab)
 
     def shard_fn(params, opt_state, tokens):
         n = lax.axis_size(axis_name)
 
         def loss_fn(p):
-            logits = apply_transformer_tp(cfg, p, tokens, axis_name)
+            logits = apply_transformer_tp(
+                cfg, p, tokens, axis_name, shard_vocab=shard_vocab
+            )
             # With check_vma=False, shard_map AD computes exact grads of the
             # SUM over shards of the per-shard outputs (psum transposes to
             # psum — the correct transpose of that global function). Every
             # shard computes the identical loss, so differentiate loss/n:
             # sharded leaves' grads come out exact; replicated leaves' grads
             # come out as per-shard partials whose psum is exact (below).
+            if shard_vocab:
+                return vocab_parallel_nll(logits, tokens, axis_name) / n
             return next_token_nll(logits, tokens) / n
 
         loss, grads = jax.value_and_grad(loss_fn)(params)

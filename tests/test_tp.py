@@ -137,3 +137,58 @@ def test_tp_grads_match_single_device(tp_mesh):
         got,
         want,
     )
+
+
+def test_vocab_parallel_tp_matches_replicated(tp_mesh):
+    """shard_vocab=True: same loss and same one-step update as the
+    replicated-embedding TP path (which itself matches single-device)."""
+    from ps_pytorch_tpu.parallel.tp import make_tp_train_step
+
+    cfg = TransformerConfig(vocab_size=64, dim=32, depth=2, heads=8,
+                            max_seq_len=16)
+    tx = sgd(0.1)
+    params = init_transformer(cfg, jax.random.key(11))
+    rng = np.random.RandomState(11)
+    tokens = jnp.asarray(rng.randint(0, 64, (4, 16)), jnp.int32)
+
+    outs = {}
+    for sv in (False, True):
+        p = shard_params_tp(cfg, to_tp_layout(cfg, params), tp_mesh,
+                            shard_vocab=sv)
+        step = make_tp_train_step(cfg, tx, tp_mesh, shard_vocab=sv,
+                                  donate=False)
+        new_p, _, loss = step(p, tx.init(p), tokens)
+        outs[sv] = (from_tp_layout(cfg, jax.device_get(new_p)), float(loss))
+
+    assert abs(outs[False][1] - outs[True][1]) < 2e-5, (outs[False][1],
+                                                        outs[True][1])
+    jax.tree.map(
+        lambda a, b: np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=3e-5, atol=3e-5
+        ),
+        outs[False][0],
+        outs[True][0],
+    )
+
+
+def test_vocab_parallel_embedding_actually_sharded(tp_mesh):
+    from ps_pytorch_tpu.parallel.tp import TP_AXIS, init_tp_state
+
+    cfg = TransformerConfig(vocab_size=64, dim=32, depth=1, heads=8,
+                            max_seq_len=16)
+    tx = sgd(0.1, momentum=0.9)
+    params, opt = init_tp_state(cfg, tx, jax.random.key(12), tp_mesh,
+                                shard_vocab=True)
+    emb = params["embed"]
+    assert emb.sharding.spec[0] == TP_AXIS
+    assert emb.addressable_shards[0].data.shape[0] == 64 // 8
+    assert opt.momentum_buffer["embed"].sharding.spec[0] == TP_AXIS
+
+
+def test_vocab_parallel_requires_divisibility(tp_mesh):
+    cfg = TransformerConfig(vocab_size=61, dim=32, depth=1, heads=8,
+                            max_seq_len=16)
+    params = init_transformer(cfg, jax.random.key(0))
+    with pytest.raises(ValueError, match="vocab"):
+        shard_params_tp(cfg, to_tp_layout(cfg, params), tp_mesh,
+                        shard_vocab=True)
