@@ -130,11 +130,11 @@ def main(argv=None) -> dict:
                         help="checkpoint every N steps (0 = only at the end)")
     args = parser.parse_args(argv)
 
-    if args.shard_vocab and args.parallelism != "tp":
+    if args.shard_vocab and args.parallelism not in ("tp", "dp_tp"):
         raise ValueError(
-            "--shard-vocab is implemented for --parallelism tp only (the "
-            "other schemes keep the embedding replicated and would silently "
-            "ignore it)"
+            "--shard-vocab is implemented for --parallelism tp/dp_tp only "
+            "(the other schemes keep the embedding replicated and would "
+            "silently ignore it)"
         )
     if args.attention_impl == "flash" and args.parallelism == "dp_sp":
         raise ValueError(
@@ -230,11 +230,15 @@ def main(argv=None) -> dict:
                 f"--batch-size must be divisible by num_dp={args.num_dp}"
             )
         mesh = make_mesh_dp_tp(args.num_dp, num_tp)
-        params, opt_state = init_dp_tp_state(cfg, tx, key, mesh)
-        step = make_dp_tp_train_step(cfg, tx, mesh)
+        params, opt_state = init_dp_tp_state(
+            cfg, tx, key, mesh, shard_vocab=args.shard_vocab
+        )
+        step = make_dp_tp_train_step(cfg, tx, mesh, shard_vocab=args.shard_vocab)
         run = lambda p, o, tok: step(p, o, shard_tokens_dp(jnp.asarray(tok), mesh))
         to_plain = lambda p: from_tp_layout(cfg, p)
-        layout = f"dp {args.num_dp} x tp {num_tp}"
+        layout = f"dp {args.num_dp} x tp {num_tp}" + (
+            " (vocab-parallel)" if args.shard_vocab else ""
+        )
     elif args.parallelism == "pp":
         from ..parallel.pp import (
             from_pp_layout,

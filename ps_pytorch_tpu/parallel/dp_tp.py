@@ -59,19 +59,22 @@ def make_mesh_dp_tp(
     )
 
 
-def init_dp_tp_state(cfg, tx, key, mesh, tp_axis: str = TP_AXIS):
+def init_dp_tp_state(cfg, tx, key, mesh, tp_axis: str = TP_AXIS,
+                     shard_vocab: bool = False):
     """Init (params_tp, opt_state): TP-sharded over `model`, replicated
     over `workers` (the specs name only the tp axis; dp replication is
     implicit)."""
     from ..models.transformer import init_transformer
 
-    # shard_params_tp validates heads/mlp divisibility by the tp axis size
+    # shard_params_tp validates heads/mlp/vocab divisibility by the tp axis
     params = shard_params_tp(
-        cfg, to_tp_layout(cfg, init_transformer(cfg, key)), mesh, tp_axis
+        cfg, to_tp_layout(cfg, init_transformer(cfg, key)), mesh, tp_axis,
+        shard_vocab=shard_vocab,
     )
     opt_state = tx.init(params)
+    specs = tp_param_specs(cfg, tp_axis, shard_vocab)
     return params, place_on_mesh(
-        opt_state, mesh, opt_state_specs(opt_state, params, tp_param_specs(cfg, tp_axis))
+        opt_state, mesh, opt_state_specs(opt_state, params, specs)
     )
 
 
@@ -87,19 +90,29 @@ def make_dp_tp_train_step(
     dp_axis: str = WORKER_AXIS,
     tp_axis: str = TP_AXIS,
     donate: bool = True,
+    shard_vocab: bool = False,
 ):
     """Jitted 2-D train step: (params_tp, opt_state, tokens) ->
     (params_tp, opt_state, loss). tokens sharded [B over dp]; loss is the
-    global batch mean."""
-    specs_tree = tp_param_specs(cfg, tp_axis)
+    global batch mean. shard_vocab runs the embedding/loss vocab-parallel
+    over the tp axis (tp.vocab_parallel_nll) — the gradient scaling below
+    is unchanged because the vocab-parallel loss is still identical across
+    the tp shards of a dp row."""
+    from .tp import vocab_parallel_nll
+
+    specs_tree = tp_param_specs(cfg, tp_axis, shard_vocab)
 
     def shard_fn(params, opt_state, tokens):
         n_tp = lax.axis_size(tp_axis)
         n_dp = lax.axis_size(dp_axis)
 
         def loss_fn(p):
-            logits = apply_transformer_tp(cfg, p, tokens, tp_axis)
+            logits = apply_transformer_tp(
+                cfg, p, tokens, tp_axis, shard_vocab=shard_vocab
+            )
             # scale per the module-docstring gradient math
+            if shard_vocab:
+                return vocab_parallel_nll(logits, tokens, tp_axis) / (n_tp * n_dp)
             return next_token_nll(logits, tokens) / (n_tp * n_dp)
 
         loss, grads = jax.value_and_grad(loss_fn)(params)
