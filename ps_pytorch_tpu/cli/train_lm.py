@@ -105,7 +105,7 @@ def main(argv=None) -> dict:
                         choices=["naive", "flash"],
                         help="within-chip attention kernel (flash = Pallas)")
     parser.add_argument("--shard-vocab", action="store_true",
-                        help="tp only: vocab-parallel embedding + loss "
+                        help="tp/dp_tp: vocab-parallel embedding + loss "
                              "(full logits never materialize per device)")
     parser.add_argument("--num-shards", type=int, default=0,
                         help="tp/pp/moe axis size (0 = all devices)")

@@ -81,8 +81,6 @@ def test_dp_tp_training_decreases_loss():
 def test_dp_tp_vocab_parallel_matches_single_device():
     """2x4 dp x tp with the vocab-sharded embedding: still one-step exact
     vs the single-device oracle."""
-    from ps_pytorch_tpu.parallel.dp_tp import init_dp_tp_state
-
     cfg = TransformerConfig(vocab_size=48, dim=32, depth=2, heads=8,
                             max_seq_len=16)
     mesh = make_mesh_dp_tp(2, 4)
@@ -96,9 +94,6 @@ def test_dp_tp_vocab_parallel_matches_single_device():
 
     loss_ref, grads = jax.value_and_grad(oracle)(params)
     want = jax.tree.map(lambda p, g: p - 0.1 * g, params, grads)
-
-    from ps_pytorch_tpu.parallel.mesh import place_on_mesh
-    from ps_pytorch_tpu.parallel.tp import tp_param_specs
 
     params_tp = place_on_mesh(
         to_tp_layout(cfg, params), mesh, tp_param_specs(cfg, shard_vocab=True)
