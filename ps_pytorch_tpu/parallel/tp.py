@@ -231,15 +231,22 @@ def vocab_parallel_nll(
 
 
 def make_tp_forward(
-    cfg: TransformerConfig, mesh: Mesh, axis_name: str = TP_AXIS, jit: bool = True
+    cfg: TransformerConfig, mesh: Mesh, axis_name: str = TP_AXIS, jit: bool = True,
+    shard_vocab: bool = False,
 ):
     """Tensor-parallel forward: params in TP layout (sharded per
-    `tp_param_specs`), tokens replicated -> replicated logits."""
+    `tp_param_specs`), tokens replicated -> logits. Replicated [B, T, V]
+    by default; with shard_vocab the logits come back as a GLOBAL array
+    sharded on the vocab dim (the full tensor still never lives on one
+    device)."""
     mapped = jax.shard_map(
-        partial(apply_transformer_tp, cfg, axis_name=axis_name),
+        partial(
+            apply_transformer_tp, cfg, axis_name=axis_name,
+            shard_vocab=shard_vocab,
+        ),
         mesh=mesh,
-        in_specs=(tp_param_specs(cfg, axis_name), P()),
-        out_specs=P(),
+        in_specs=(tp_param_specs(cfg, axis_name, shard_vocab), P()),
+        out_specs=P(None, None, axis_name) if shard_vocab else P(),
         check_vma=False,
     )
     return jax.jit(mapped) if jit else mapped

@@ -192,3 +192,22 @@ def test_vocab_parallel_requires_divisibility(tp_mesh):
     with pytest.raises(ValueError, match="vocab"):
         shard_params_tp(cfg, to_tp_layout(cfg, params), tp_mesh,
                         shard_vocab=True)
+
+
+def test_vocab_parallel_forward_matches_and_stays_sharded(tp_mesh):
+    cfg = TransformerConfig(vocab_size=64, dim=32, depth=2, heads=8,
+                            max_seq_len=16)
+    params = init_transformer(cfg, jax.random.key(13))
+    rng = np.random.RandomState(13)
+    tokens = jnp.asarray(rng.randint(0, 64, (2, 16)), jnp.int32)
+    want = apply_transformer(cfg, params, tokens)
+
+    p_sv = shard_params_tp(cfg, to_tp_layout(cfg, params), tp_mesh,
+                           shard_vocab=True)
+    got = make_tp_forward(cfg, tp_mesh, shard_vocab=True)(p_sv, tokens)
+    assert got.shape == want.shape
+    assert got.sharding.spec[-1] == TP_AXIS  # vocab dim stays sharded
+    assert got.addressable_shards[0].data.shape[-1] == 64 // 8
+    np.testing.assert_allclose(
+        np.asarray(got), np.asarray(want), rtol=2e-5, atol=2e-5
+    )
