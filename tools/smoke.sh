@@ -1,0 +1,42 @@
+#!/usr/bin/env bash
+# One-shot smoke of the full product surface on a virtual 8-device CPU mesh
+# (no TPU needed). Exercises: the multi-chip dryrun (all parallelism axes),
+# the PS CNN trainer + evaluator, the LM trainer on tp with vocab-parallel
+# embedding + the LM evaluator with KV-cache sampling, and the headline
+# benchmark in its trimmed form. Budget ~5 minutes of CPU (compiles dominate).
+#
+#   bash tools/smoke.sh
+set -euo pipefail
+cd "$(dirname "$0")/.."
+
+run() {
+  echo "== $*"
+  # env -i strips everything else, so forward the bench knobs explicitly
+  env -i PATH="$PATH" HOME="$HOME" \
+      JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \
+      BENCH_STEPS="${BENCH_STEPS:-2}" \
+      "$@"
+}
+
+TMP=$(mktemp -d)
+trap 'rm -rf "$TMP"' EXIT
+
+run python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
+
+run python -m ps_pytorch_tpu.cli.train \
+    --network LeNet --dataset MNIST --num-workers 8 --batch-size 64 \
+    --grad-accum-steps 2 --max-steps 6 --eval-freq 3 --log-interval 3 \
+    --train-dir "$TMP/cnn"
+run python -m ps_pytorch_tpu.cli.evaluate \
+    --network LeNet --dataset MNIST --model-dir "$TMP/cnn" --once
+
+run python -m ps_pytorch_tpu.cli.train_lm \
+    --parallelism tp --heads 8 --dim 64 --vocab-size 64 --shard-vocab \
+    --seq-len 64 --max-steps 20 --log-interval 10 --lr 0.3 \
+    --train-dir "$TMP/lm" --eval-freq 10
+run python -m ps_pytorch_tpu.cli.evaluate_lm \
+    --model-dir "$TMP/lm" --once --generate 16
+
+run python bench.py
+
+echo "SMOKE OK"
