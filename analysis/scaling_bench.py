@@ -87,7 +87,6 @@ def bench_lm_mesh(parallelism, num_shards, batch, seq_len, steps, lm_kw):
     # reads --num-shards (passing the wrong one would silently rerun the
     # same configuration at every sweep point)
     axis_flag = "--num-sp" if parallelism == "dp_sp" else "--num-shards"
-    t0 = time.perf_counter()
     out = lm_main(
         [
             "--parallelism", parallelism,
@@ -102,15 +101,17 @@ def bench_lm_mesh(parallelism, num_shards, batch, seq_len, steps, lm_kw):
             "--heads", str(lm_kw.get("heads", 8)),
         ]
     )
-    dt = time.perf_counter() - t0
+    # steady-state window reported by train_lm (host_sync-bracketed steps
+    # after warmup) — JIT compile, mesh/data setup and checkpointing are
+    # excluded, so speedup/efficiency across the sweep compare execution,
+    # not per-shard-count compile time.
+    dt, n_steady = out["steady_elapsed_s"], out["steady_steps"]
     return {
         "parallelism": parallelism,
         "shards": num_shards,
         "batch": batch,
         "seq_len": seq_len,
-        # end-to-end wall including the first-step compile — raise --steps
-        # on real hardware to amortize it (the ps workload excludes compile)
-        "tokens_per_sec": round(batch * seq_len * (steps + 2) / dt, 1),
+        "tokens_per_sec": round(batch * seq_len * n_steady / dt, 1),
         "final_loss": round(out["loss"], 4),
     }
 

@@ -26,26 +26,13 @@ import subprocess
 import sys
 
 _REPO_ROOT = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, _REPO_ROOT)
 
-
-def _env_is_clean() -> bool:
-    return not os.environ.get("PALLAS_AXON_POOL_IPS") and os.environ.get(
-        "JAX_PLATFORMS", "cpu"
-    ) == "cpu"
-
-
-def _clean_env() -> dict:
-    env = dict(os.environ)
-    env["PALLAS_AXON_POOL_IPS"] = ""
-    env["JAX_PLATFORMS"] = "cpu"
-    flags = env.get("XLA_FLAGS", "")
-    if "xla_force_host_platform_device_count" not in flags:
-        env["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
-    return env
+from tpu_env import clean_cpu_env, env_is_clean  # noqa: E402 (stdlib-only)
 
 
 def pytest_configure(config):
-    if _env_is_clean():
+    if env_is_clean():
         return
 
     # Absolutize positional test paths (node ids may carry ::selectors).
@@ -79,5 +66,5 @@ def pytest_configure(config):
             "pytest",
             *args,
         ],
-        _clean_env(),
+        clean_cpu_env(),
     )

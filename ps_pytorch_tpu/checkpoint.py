@@ -40,9 +40,51 @@ def checkpoint_path(model_dir: str, step: int) -> str:
     return os.path.join(model_dir, f"model_step_{step}")
 
 
+def _gather_host_state(state):
+    """Bring `state` to full host arrays on every process.
+
+    Single-process: plain device_get. Multi-host (process_count > 1):
+    ONLY leaves that are jax.Arrays with non-addressable shards get the
+    multihost_utils gather (a collective — every process must call this,
+    and every process holds the same pytree structure, so the per-leaf
+    collectives line up). Host-local leaves (numpy arrays, scalars,
+    metadata strings) pass through untouched — handing them to
+    process_allgather would stack/concat them per-process. The writer
+    side then keeps exactly one process writing (see save_checkpoint)."""
+    if jax.process_count() <= 1:
+        return jax.device_get(state)
+    from jax.experimental import multihost_utils
+
+    def leaf(x):
+        if isinstance(x, jax.Array) and not x.is_fully_addressable:
+            return multihost_utils.process_allgather(x, tiled=True)
+        if isinstance(x, jax.Array):
+            return jax.device_get(x)
+        return x
+
+    return jax.tree.map(leaf, state)
+
+
 def save_checkpoint(state, model_dir: str, step: int, compress: bool = False) -> str:
-    """Atomically write `state` (any flax-serializable pytree) for `step`."""
-    return _write_host_state(jax.device_get(state), model_dir, step, compress)
+    """Atomically write `state` (any flax-serializable pytree) for `step`.
+
+    Multi-host: collective (all processes must call it — the gather is a
+    collective op); only process 0 writes the file, preserving the
+    single-writer guarantee, and a barrier after the write means the
+    write has COMPLETED before any process returns. The path is on
+    process 0's filesystem: reading it from other processes (e.g.
+    --resume after preemption) requires `model_dir` to be on storage all
+    hosts share — a gcsfuse bucket (tools/tpu_cluster.py mount) or NFS,
+    exactly like the reference's NFS train_dir (README.md:23)."""
+    host_state = _gather_host_state(state)
+    path = checkpoint_path(model_dir, step)
+    if jax.process_index() == 0:
+        _write_host_state(host_state, model_dir, step, compress)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        multihost_utils.sync_global_devices(f"ckpt_save_{step}")
+    return path
 
 
 def _write_host_state(state, model_dir: str, step: int, compress: bool) -> str:
@@ -83,7 +125,14 @@ class AsyncCheckpointer:
         self._pending = None
 
     def save(self, state, model_dir: str, step: int, compress: bool = False):
-        host_state = jax.device_get(state)
+        if jax.process_count() > 1:
+            # multi-host: degrade to the synchronous collective save — its
+            # barrier gives every process a durable-write guarantee, which
+            # an async submit on process 0 alone cannot (the other
+            # processes' wait() would be a no-op on an unwritten file)
+            save_checkpoint(state, model_dir, step, compress)
+            return
+        host_state = _gather_host_state(state)
         self.wait()  # keep at most one write in flight
         self._pending = self._pool.submit(
             _write_host_state, host_state, model_dir, step, compress
@@ -117,9 +166,10 @@ def restore_sharded(target, model_dir: str, step: int, mesh, specs):
     output or an opt_state_specs tree).
 
     save_checkpoint gathers sharded arrays to full host arrays
-    (jax.device_get), so a checkpoint written from a tp/pp/moe-sharded
+    (device_get single-process; multihost_utils.process_allgather when
+    process_count > 1), so a checkpoint written from a tp/pp/moe-sharded
     state restores onto ANY mesh shape whose specs divide the shapes —
-    resharding across different device counts is free.
+    resharding across different device counts (and host counts) is free.
     """
     from .parallel.mesh import place_on_mesh
 

@@ -136,11 +136,16 @@ def main(argv=None) -> dict:
             "(the other schemes keep the embedding replicated and would "
             "silently ignore it)"
         )
-    if args.attention_impl == "flash" and args.parallelism == "dp_sp":
+    if (
+        args.attention_impl == "flash"
+        and args.parallelism == "dp_sp"
+        and args.sp_attention == "ring"
+        and args.bidirectional_ring
+    ):
         raise ValueError(
-            "--attention-impl flash applies to the within-chip attention of "
-            "the tp/pp/moe paths; --parallelism dp_sp attends via "
-            "--sp-attention (ring/ulysses) and would silently ignore it"
+            "--attention-impl flash supports the one-way ring only "
+            "(ring_flash_attention); drop --bidirectional-ring or use "
+            "--attention-impl naive"
         )
     cfg = TransformerConfig(
         vocab_size=args.vocab_size,
@@ -334,12 +339,21 @@ def main(argv=None) -> dict:
     loss = float("nan")
     profiling = False
     profile_stop = min(12, args.max_steps)
+    # steady-state window: everything after the first `warmup` steps
+    # (compile + settle), bracketed by host_sync barriers so the derived
+    # tokens/sec excludes JIT compile and setup (scaling_bench consumes it)
+    warmup = min(2, args.max_steps - 1)
+    steady_t0 = None
+    steady = {}
     if args.profile_dir and args.max_steps < 3:
         logger.warning(
             "--profile-dir set but max-steps < 3: tracing starts at step 3 "
             "(after compile + settle), so no trace will be written"
         )
     for step_no in range(1, args.max_steps + 1):
+        if step_no == warmup + 1 and args.max_steps > warmup:
+            host_sync(params)
+            steady_t0 = time.perf_counter()
         if args.profile_dir and step_no == 3:  # after compile + settle
             jax.profiler.start_trace(args.profile_dir)
             profiling = True
@@ -379,11 +393,17 @@ def main(argv=None) -> dict:
             logger.info("profiler trace written to %s", args.profile_dir)
         if args.eval_freq > 0 and step_no % args.eval_freq == 0:
             save_lm_checkpoint(step_no)
+    if steady_t0 is not None:
+        host_sync(params)  # params chain: serializes the whole window
+        steady = {
+            "steady_steps": args.max_steps - warmup,
+            "steady_elapsed_s": time.perf_counter() - steady_t0,
+        }
     if args.train_dir is not None and (
         args.eval_freq <= 0 or args.max_steps % args.eval_freq
     ):
         save_lm_checkpoint(args.max_steps)
-    return {"loss": float(loss), "params": n_params}
+    return {"loss": float(loss), "params": n_params, **steady}
 
 
 if __name__ == "__main__":

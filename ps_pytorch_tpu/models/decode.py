@@ -46,11 +46,23 @@ def _attend_cached(q, k_cache, v_cache, length, scale):
 
     length is a traced scalar (the number of valid cache slots, including
     the position q is at)."""
-    scores = jnp.einsum("bqhd,bkhd->bhqk", q, k_cache) * scale  # [B,H,1,L]
+    # f32 scores/softmax regardless of compute dtype — the same softmax-
+    # statistics convention as full/ring/flash attention in training, so
+    # bf16 decode cannot numerically diverge from the training forward.
+    scores = (
+        jnp.einsum(
+            "bqhd,bkhd->bhqk", q, k_cache, preferred_element_type=jnp.float32
+        )
+        * scale
+    )  # [B,H,1,L] f32
     pos = jnp.arange(k_cache.shape[1])
     scores = jnp.where(pos[None, None, None, :] < length, scores, NEG_INF)
     p = jax.nn.softmax(scores, axis=-1)
-    return jnp.einsum("bhqk,bkhd->bqhd", p, v_cache)
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", p.astype(v_cache.dtype), v_cache,
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)
 
 
 def _decode_one(cfg: TransformerConfig, params: Dict, cache: Dict,

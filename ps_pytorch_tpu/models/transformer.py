@@ -48,9 +48,11 @@ class TransformerConfig:
     # parallel/ulysses.py for the trade-off
     sp_attention: str = "ring"
     # within-chip attention: "naive" (materializes [T, T]) or "flash"
-    # (Pallas blockwise kernel, ops/flash_attention.py). Applies to the
-    # single-device, tp, pp, and moe paths; the sp paths communicate via
-    # ring/ulysses and keep their own per-block math
+    # (Pallas blockwise kernel, ops/flash_attention.py). Applies to ALL
+    # paths: single-device/tp/pp/moe use it directly; sp "ring" switches
+    # to ring_flash_attention (partial-triple kernel per hop, never
+    # [T_loc, T_loc]; one-way ring only) and sp "ulysses" runs it on the
+    # gathered full-seq/local-heads layout
     attention_impl: str = "naive"
     # mixed precision: params/optimizer state stay `dtype` (keep f32 —
     # bf16 Adam moments are broken: bf16(0.999) == 1.0), while block
@@ -168,15 +170,35 @@ def apply_transformer(
             from ..parallel.ulysses import ulysses_attention
 
             attend = partial(
-                ulysses_attention, axis_name=seq_axis_name, causal=cfg.causal
+                ulysses_attention, axis_name=seq_axis_name, causal=cfg.causal,
+                impl=cfg.attention_impl,
             )
         elif cfg.sp_attention == "ring":
-            attend = partial(
-                ring_attention,
-                axis_name=seq_axis_name,
-                causal=cfg.causal,
-                bidirectional=cfg.bidirectional_ring,
-            )
+            if cfg.attention_impl == "flash":
+                if cfg.bidirectional_ring:
+                    # refuse rather than silently hand back the
+                    # [T_loc, T_loc]-materializing jnp ring the user
+                    # explicitly opted out of (make_ring_attention agrees)
+                    raise ValueError(
+                        "attention_impl='flash' supports the one-way ring "
+                        "only; unset bidirectional_ring or use naive"
+                    )
+                # flash INSIDE each ring hop: no [T_loc, T_loc] block ever
+                # materializes (ops/flash_attention partial-triple kernels)
+                from ..parallel.ring_attention import ring_flash_attention
+
+                attend = partial(
+                    ring_flash_attention,
+                    axis_name=seq_axis_name,
+                    causal=cfg.causal,
+                )
+            else:
+                attend = partial(
+                    ring_attention,
+                    axis_name=seq_axis_name,
+                    causal=cfg.causal,
+                    bidirectional=cfg.bidirectional_ring,
+                )
         else:
             raise ValueError(f"unknown sp_attention {cfg.sp_attention!r}")
     else:

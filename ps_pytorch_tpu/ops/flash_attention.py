@@ -37,15 +37,18 @@ import jax.numpy as jnp
 NEG_INF = -1e30
 
 
-def _mask_causal(scores, qi, ki, block_q, block_k):
+def _mask_causal(scores, qi, ki, block_q, block_k, q_off=0, k_off=0):
     """Apply the causal mask to one [block_q, block_k] score tile, with
-    positions taken from the grid indices. The ONE masking implementation
-    shared by the forward, dq, and dkv kernels — they must never diverge
-    or gradients silently stop matching the forward."""
-    q_pos = qi * block_q + jax.lax.broadcasted_iota(
+    positions taken from the grid indices plus GLOBAL offsets (q_off/k_off
+    are 0 single-chip; on a sequence-parallel ring they are the traced
+    shard offsets of the local q block and the visiting k block). The ONE
+    masking implementation shared by the forward, dq, and dkv kernels —
+    they must never diverge or gradients silently stop matching the
+    forward."""
+    q_pos = q_off + qi * block_q + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 0
     )
-    k_pos = ki * block_k + jax.lax.broadcasted_iota(
+    k_pos = k_off + ki * block_k + jax.lax.broadcasted_iota(
         jnp.int32, (block_q, block_k), 1
     )
     return jnp.where(k_pos <= q_pos, scores, NEG_INF)
@@ -64,10 +67,14 @@ def _pallas_mode() -> Optional[dict]:
 # --------------------------------------------------------------- forward
 
 
-def _make_fwd_kernel(scale, causal, block_q, block_k, n_k):
+def _make_fwd_kernel(scale, causal, block_q, block_k, n_k, normalize):
     from jax.experimental import pallas as pl
 
-    def kernel(q_ref, k_ref, v_ref, o_ref, lse_ref, acc_ref, m_ref, l_ref):
+    def kernel(off_ref, q_ref, k_ref, v_ref, *out_and_scratch):
+        if normalize:
+            o_ref, lse_ref, acc_ref, m_ref, l_ref = out_and_scratch
+        else:
+            pv_ref, mo_ref, lo_ref, acc_ref, m_ref, l_ref = out_and_scratch
         qi = pl.program_id(1)
         ki = pl.program_id(2)
 
@@ -83,7 +90,10 @@ def _make_fwd_kernel(scale, causal, block_q, block_k, n_k):
         scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
 
         if causal:
-            scores = _mask_causal(scores, qi, ki, block_q, block_k)
+            scores = _mask_causal(
+                scores, qi, ki, block_q, block_k,
+                off_ref[0, 0], off_ref[0, 1],
+            )
 
         m_prev = m_ref[:]  # [Bq, 1]
         m_blk = jnp.max(scores, axis=-1, keepdims=True)
@@ -101,45 +111,91 @@ def _make_fwd_kernel(scale, causal, block_q, block_k, n_k):
 
         @pl.when(ki == n_k - 1)
         def _finalize():
-            l = l_ref[:]
-            l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0
-            o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
-            lse_ref[0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
+            if normalize:
+                l = l_ref[:]
+                l_safe = jnp.where(l == 0.0, 1.0, l)  # fully-masked rows -> 0
+                o_ref[0] = (acc_ref[:] / l_safe).astype(o_ref.dtype)
+                lse_ref[0] = (m_ref[:] + jnp.log(l_safe))[:, 0]
+            else:
+                # partial triple for ring hops: UNNORMALIZED numerator plus
+                # the (m, l) stats, merged across hops by the caller
+                pv_ref[0] = acc_ref[:]
+                mo_ref[0] = m_ref[:][:, 0]
+                lo_ref[0] = l_ref[:][:, 0]
 
     return kernel
 
 
-def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, mode):
-    """q3/k3/v3: [BH, T, D] f32 -> (o [BH, T, D], lse [BH, T])."""
+def _offsets_arr(offsets):
+    """(q_off, k_off) traced/static scalars -> (1, 2) i32 SMEM operand."""
+    if offsets is None:
+        return jnp.zeros((1, 2), jnp.int32)
+    q_off, k_off = offsets
+    return jnp.stack(
+        [jnp.asarray(q_off, jnp.int32), jnp.asarray(k_off, jnp.int32)]
+    )[None]
+
+
+def _smem_spec():
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    return pl.BlockSpec(
+        (1, 2), lambda *_: (0, 0), memory_space=pltpu.SMEM
+    )
+
+
+def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, mode,
+               offsets=None, normalize=True):
+    """q3/k3/v3: [BH, T, D] -> (o [BH, T, D], lse [BH, T]) when normalize,
+    else the partial triple (pv f32 [BH, T, D], m f32 [BH, T], l f32
+    [BH, T]) for ring-hop merging. `offsets` shifts the causal mask's
+    global positions (see _mask_causal)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, t, d = q3.shape
-    n_q, n_k = t // block_q, t // block_k
-    kernel = _make_fwd_kernel(scale, causal, block_q, block_k, n_k)
+    tk = k3.shape[1]
+    n_q, n_k = t // block_q, tk // block_k
+    kernel = _make_fwd_kernel(scale, causal, block_q, block_k, n_k, normalize)
+    if normalize:
+        out_specs = [
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda b, qi, ki: (b, qi)),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ]
+    else:
+        out_specs = [
+            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
+            pl.BlockSpec((1, block_q), lambda b, qi, ki: (b, qi)),
+            pl.BlockSpec((1, block_q), lambda b, qi, ki: (b, qi)),
+        ]
+        out_shape = [
+            jax.ShapeDtypeStruct((bh, t, d), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+            jax.ShapeDtypeStruct((bh, t), jnp.float32),
+        ]
     return pl.pallas_call(
         kernel,
         grid=(bh, n_q, n_k),
         in_specs=[
+            _smem_spec(),
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
         ],
-        out_specs=[
-            pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-            pl.BlockSpec((1, block_q), lambda b, qi, ki: (b, qi)),
-        ],
-        out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
-            jax.ShapeDtypeStruct((bh, t), jnp.float32),
-        ],
+        out_specs=out_specs,
+        out_shape=out_shape,
         scratch_shapes=[
             pltpu.VMEM((block_q, d), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
             pltpu.VMEM((block_q, 1), jnp.float32),
         ],
         **mode,
-    )(q3, k3, v3)
+    )(_offsets_arr(offsets), q3, k3, v3)
 
 
 # --------------------------------------------------------------- backward
@@ -148,7 +204,8 @@ def _flash_fwd(q3, k3, v3, scale, causal, block_q, block_k, mode):
 def _make_dq_kernel(scale, causal, block_q, block_k, n_k):
     from jax.experimental import pallas as pl
 
-    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref, dq_ref, acc_ref):
+    def kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+               dq_ref, acc_ref):
         qi = pl.program_id(1)
         ki = pl.program_id(2)
 
@@ -161,7 +218,10 @@ def _make_dq_kernel(scale, causal, block_q, block_k, n_k):
         delta = delta_ref[0][:, None]  # [Bq, 1]
         scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            scores = _mask_causal(scores, qi, ki, block_q, block_k)
+            scores = _mask_causal(
+                scores, qi, ki, block_q, block_k,
+                off_ref[0, 0], off_ref[0, 1],
+            )
         p = jnp.exp(scores - lse)  # exact softmax probs, [Bq, Bk]
         # fully-masked rows: lse == NEG_INF and scores == NEG_INF give
         # exp(0) = 1; such rows contributed nothing forward, so zero them
@@ -180,7 +240,7 @@ def _make_dq_kernel(scale, causal, block_q, block_k, n_k):
 def _make_dkv_kernel(scale, causal, block_q, block_k, n_q):
     from jax.experimental import pallas as pl
 
-    def kernel(q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
+    def kernel(off_ref, q_ref, k_ref, v_ref, do_ref, lse_ref, delta_ref,
                dk_ref, dv_ref, dk_acc, dv_acc):
         ki = pl.program_id(1)
         qi = pl.program_id(2)
@@ -195,7 +255,10 @@ def _make_dkv_kernel(scale, causal, block_q, block_k, n_q):
         delta = delta_ref[0][:, None]
         scores = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * scale
         if causal:
-            scores = _mask_causal(scores, qi, ki, block_q, block_k)
+            scores = _mask_causal(
+                scores, qi, ki, block_q, block_k,
+                off_ref[0, 0], off_ref[0, 1],
+            )
         p = jnp.exp(scores - lse)  # [Bq, Bk]
         p = jnp.where(lse > NEG_INF / 2, p, 0.0)  # fully-masked rows (see dq)
         dv_acc[:] += jnp.dot(p.T, do, preferred_element_type=jnp.float32)
@@ -211,18 +274,31 @@ def _make_dkv_kernel(scale, causal, block_q, block_k, n_q):
     return kernel
 
 
-def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k, mode):
+def _flash_bwd(q3, k3, v3, lse, delta, do3, scale, causal, block_q, block_k,
+               mode, offsets=None, out_dtype=None):
+    """Blockwise gradients. `lse`/`delta` are the FINAL (post-merge)
+    softmax stats — single-chip they come straight from the forward; on a
+    ring every hop reuses the globally-merged values, which is what makes
+    per-hop contributions sum to the exact gradient. k3/v3 may have a
+    different sequence length than q3 (a visiting ring shard).
+    `out_dtype` overrides the gradients' dtype (the ring passes f32 so
+    per-hop pieces accumulate without a per-hop rounding)."""
     from jax.experimental import pallas as pl
     from jax.experimental.pallas import tpu as pltpu
 
     bh, t, d = q3.shape
-    n_q, n_k = t // block_q, t // block_k
-    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
+    tk = k3.shape[1]
+    n_q, n_k = t // block_q, tk // block_k
+    off = _offsets_arr(offsets)
+    dq_dt = out_dtype or q3.dtype
+    dk_dt = out_dtype or k3.dtype
+    dv_dt = out_dtype or v3.dtype
 
     dq = pl.pallas_call(
         _make_dq_kernel(scale, causal, block_q, block_k, n_k),
         grid=(bh, n_q, n_k),
         in_specs=[
+            _smem_spec(),
             pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, qi, ki: (b, ki, 0)),
@@ -231,15 +307,16 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k, mode):
             pl.BlockSpec((1, block_q), lambda b, qi, ki: (b, qi)),
         ],
         out_specs=pl.BlockSpec((1, block_q, d), lambda b, qi, ki: (b, qi, 0)),
-        out_shape=jax.ShapeDtypeStruct((bh, t, d), q3.dtype),
+        out_shape=jax.ShapeDtypeStruct((bh, t, d), dq_dt),
         scratch_shapes=[pltpu.VMEM((block_q, d), jnp.float32)],
         **mode,
-    )(q3, k3, v3, do3, lse, delta)
+    )(off, q3, k3, v3, do3, lse, delta)
 
     dk, dv = pl.pallas_call(
         _make_dkv_kernel(scale, causal, block_q, block_k, n_q),
         grid=(bh, n_k, n_q),
         in_specs=[
+            _smem_spec(),
             pl.BlockSpec((1, block_q, d), lambda b, ki, qi: (b, qi, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
             pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
@@ -252,15 +329,15 @@ def _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal, block_q, block_k, mode):
             pl.BlockSpec((1, block_k, d), lambda b, ki, qi: (b, ki, 0)),
         ],
         out_shape=[
-            jax.ShapeDtypeStruct((bh, t, d), k3.dtype),
-            jax.ShapeDtypeStruct((bh, t, d), v3.dtype),
+            jax.ShapeDtypeStruct((bh, tk, d), dk_dt),
+            jax.ShapeDtypeStruct((bh, tk, d), dv_dt),
         ],
         scratch_shapes=[
             pltpu.VMEM((block_k, d), jnp.float32),
             pltpu.VMEM((block_k, d), jnp.float32),
         ],
         **mode,
-    )(q3, k3, v3, do3, lse, delta)
+    )(off, q3, k3, v3, do3, lse, delta)
     return dq, dk, dv
 
 
@@ -290,7 +367,8 @@ def _flash_vjp_fwd(q3, k3, v3, scale, causal, block_q, block_k):
 def _flash_vjp_bwd(scale, causal, block_q, block_k, res, do3):
     q3, k3, v3, o3, lse = res
     mode = _pallas_mode() or {"interpret": True}
-    return _flash_bwd(q3, k3, v3, o3, lse, do3, scale, causal,
+    delta = jnp.sum(do3.astype(jnp.float32) * o3.astype(jnp.float32), axis=-1)
+    return _flash_bwd(q3, k3, v3, lse, delta, do3, scale, causal,
                       block_q, block_k, mode)
 
 
@@ -325,3 +403,40 @@ def flash_attention(
     fold = lambda x: x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
     o3 = _flash(fold(q), fold(k), fold(v), float(scale), bool(causal), bq, bk)
     return o3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+# ------------------------------------------- ring-hop partial-triple API
+# (consumed by parallel/ring_attention.ring_flash_attention: flash WITHIN
+# each ring hop, so a sequence shard never materializes [T_loc, T_loc])
+
+
+def flash_partial(q3, k3, v3, scale, causal, q_off, k_off,
+                  block_q=128, block_k=128, mode=None):
+    """One hop's UNNORMALIZED contribution: [BH, Tq, D] queries against a
+    visiting [BH, Tk, D] K/V shard -> (pv f32 [BH, Tq, D], m f32 [BH, Tq],
+    l f32 [BH, Tq]). q_off/k_off are the shards' global sequence offsets
+    (traced scalars are fine — they ride in SMEM, one compiled kernel
+    serves every hop). The caller merges triples across hops with the
+    usual online-softmax rescale and normalizes once at the end."""
+    bq = _pick_block(q3.shape[1], block_q)
+    bk = _pick_block(k3.shape[1], block_k)
+    return _flash_fwd(
+        q3, k3, v3, scale, causal, bq, bk,
+        mode if mode is not None else (_pallas_mode() or {"interpret": True}),
+        offsets=(q_off, k_off), normalize=False,
+    )
+
+
+def flash_grads_partial(q3, k3, v3, do3, lse, delta, scale, causal,
+                        q_off, k_off, block_q=128, block_k=128, mode=None):
+    """One hop's gradient contributions (dq [BH, Tq, D], dk [BH, Tk, D],
+    dv [BH, Tk, D], all f32) given the FINAL merged lse/delta — per-hop
+    pieces sum to the exact flash backward (f32 out so cross-hop
+    accumulation never rounds per hop, even under bf16 inputs)."""
+    bq = _pick_block(q3.shape[1], block_q)
+    bk = _pick_block(k3.shape[1], block_k)
+    return _flash_bwd(
+        q3, k3, v3, lse, delta, do3, scale, causal, bq, bk,
+        mode if mode is not None else (_pallas_mode() or {"interpret": True}),
+        offsets=(q_off, k_off), out_dtype=jnp.float32,
+    )

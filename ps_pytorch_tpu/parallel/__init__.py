@@ -26,6 +26,7 @@ from .ring_attention import (
     make_ring_attention,
     make_seq_mesh,
     ring_attention,
+    ring_flash_attention,
     shard_sequence,
 )
 from .dp_tp import (

@@ -135,31 +135,36 @@ def _pp_logits_and_loss(
 
     perm = [(j, (j + 1) % n) for j in range(n)]
     cd = cfg.effective_compute_dtype  # blocks emit compute_dtype activations
-    outputs0 = jnp.zeros((m, b_mb, t, cfg.dim), cd)
     y0 = jnp.zeros((b_mb, t, cfg.dim), cd)
 
+    # unembed + loss are folded INTO the tick, per finished microbatch, so
+    # the largest activation ever live is one microbatch's [B_mb, T, V]
+    # logits — never [M, B_mb, T, V] (or even [M, B_mb, T, dim]): a PP
+    # stage's memory must scale with the microbatch, not the global batch.
+    # The value is still computed uniformly on every stage (SPMD control
+    # flow); only the last stage's survives the mask+psum.
     def tick(carry, tk):
-        y, outputs = carry
+        y, loss_sum = carry
         inbound = lax.ppermute(y, axis_name, perm)
         x_in = jnp.where(stage == 0, embed(tk), inbound)
         y_new = local_blocks(x_in)
         done = tk - (n - 1)
-        outputs = jnp.where(
-            (done >= 0) & (done < m),
-            lax.dynamic_update_index_in_dim(
-                outputs, y_new[None], jnp.clip(done, 0, m - 1), 0
-            ),
-            outputs,
+        tok_mb = lax.dynamic_index_in_dim(
+            tokens, jnp.clip(done, 0, m - 1), 0, keepdims=False
         )
-        return (y_new, outputs), None
+        xf = _rms_norm(y_new, params["out_norm"].astype(cd))
+        logits = xf @ params["embed"].T.astype(cd)  # [B_mb, T, V]
+        mb_loss = next_token_nll(logits, tok_mb)
+        loss_sum = loss_sum + jnp.where(
+            (done >= 0) & (done < m), mb_loss, 0.0
+        )
+        return (y_new, loss_sum), None
 
-    (_, outputs), _ = lax.scan(tick, (y0, outputs0), jnp.arange(m + n - 1))
-
-    # unembed + loss on the last stage (computed uniformly on all stages;
-    # only the last stage's value survives the mask+psum)
-    xf = _rms_norm(outputs, params["out_norm"].astype(cd))
-    logits = xf @ params["embed"].T.astype(cd)  # [M, B_mb, T, V]
-    loss_local = next_token_nll(logits, tokens)
+    (_, loss_sum), _ = lax.scan(
+        tick, (y0, jnp.zeros((), jnp.float32)), jnp.arange(m + n - 1)
+    )
+    # equal-size microbatches: mean of per-microbatch means == global mean
+    loss_local = loss_sum / m
     return lax.psum(jnp.where(stage == n - 1, loss_local, 0.0), axis_name)
 
 

@@ -182,6 +182,150 @@ def ring_attention(
     return (o / l.transpose(0, 2, 1)[..., None]).astype(q.dtype)
 
 
+# ---------------------------------------------------------- ring + flash
+# Flash WITHIN each hop: the jnp ring above materializes a [T_loc, T_loc]
+# score block per hop; here each hop runs the Pallas partial-triple kernel
+# (ops/flash_attention.flash_partial), so per-hop memory is O(block) and
+# the full attention over N shards never builds a T_loc^2 tensor anywhere.
+# Gradients are a custom VJP: a second ring pass in which dk/dv
+# accumulators TRAVEL WITH their k/v shards (n rotations = home), each hop
+# adding its exact contribution computed from the globally-merged
+# (lse, delta) stats — summing to the exact flash backward.
+
+
+def _fold_heads(x):  # [B, T, H, D] -> [B*H, T, D] (kernel layout)
+    b, t, h, d = x.shape
+    return x.transpose(0, 2, 1, 3).reshape(b * h, t, d)
+
+
+def _unfold_heads(x3, b, h):  # inverse of _fold_heads
+    bh, t, d = x3.shape
+    return x3.reshape(b, h, t, d).transpose(0, 2, 1, 3)
+
+
+def _merge_triple(acc, hop):
+    """Online-softmax merge of two (pv [BH,T,D], m [BH,T], l [BH,T])."""
+    pv, m, l = acc
+    pv_h, m_h, l_h = hop
+    m_new = jnp.maximum(m, m_h)
+    # guard fully-masked-so-far rows: exp(_NEG_BIG - _NEG_BIG) = 1 is fine
+    # (l contributions are 0 there), but exp below must not overflow
+    alpha = jnp.exp(m - m_new)
+    beta = jnp.exp(m_h - m_new)
+    return (
+        pv * alpha[..., None] + pv_h * beta[..., None],
+        m_new,
+        l * alpha + l_h * beta,
+    )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+def ring_flash_attention(
+    q: jax.Array,
+    k: jax.Array,
+    v: jax.Array,
+    axis_name: str = SEQ_AXIS,
+    causal: bool = False,
+    scale: Optional[float] = None,
+    block_q: int = 128,
+    block_k: int = 128,
+) -> jax.Array:
+    """ring_attention with the Pallas flash kernel inside each hop.
+
+    Call inside shard_map with q/k/v sharded [B, T_local, H, D] along
+    `axis_name`. Exact (same math as ring_attention/full_attention); falls
+    back to kernel interpret mode off-TPU. Memory per hop is O(block_q x
+    block_k) VMEM scratch + the O(T_loc) (pv, m, l) running triple."""
+    o, _ = _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k)
+    return o
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k):
+    from ..ops.flash_attention import flash_partial
+
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    b, t_loc, h, d = q.shape
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    q3, k3, v3 = _fold_heads(q), _fold_heads(k), _fold_heads(v)
+    q_off = me * t_loc
+
+    pv0 = jnp.zeros(q3.shape, jnp.float32)
+    m0 = jnp.full(q3.shape[:2], _NEG_BIG, jnp.float32)
+    l0 = jnp.zeros(q3.shape[:2], jnp.float32)
+    perm_fwd = [(j, (j - 1) % n) for j in range(n)]
+
+    def hop(carry, s):
+        pv, m, l, k_c, v_c = carry
+        k_off = ((me + s) % n) * t_loc
+        triple = flash_partial(
+            q3, k_c, v_c, scale, causal, q_off, k_off, block_q, block_k
+        )
+        pv, m, l = _merge_triple((pv, m, l), triple)
+        k_c = lax.ppermute(k_c, axis_name, perm_fwd)
+        v_c = lax.ppermute(v_c, axis_name, perm_fwd)
+        return (pv, m, l, k_c, v_c), None
+
+    # k/v come home after n rotations; scan keeps one hop's buffers live
+    (pv, m, l, k3, v3), _ = lax.scan(hop, (pv0, m0, l0, k3, v3), jnp.arange(n))
+    l_safe = jnp.where(l == 0.0, 1.0, l)
+    o3 = pv / l_safe[..., None]
+    lse = m + jnp.log(l_safe)
+    o = _unfold_heads(o3, b, h).astype(q.dtype)
+    return o, (q3, k3, v3, o3, lse)
+
+
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale, block_q, block_k):
+    return _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k)
+
+
+def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k, res, do):
+    from ..ops.flash_attention import flash_grads_partial
+
+    q3, k3, v3, o3, lse = res
+    b, t_loc, h, d = do.shape  # static shape/dtype info rides on the cotangent
+    in_dtype = do.dtype
+    n = lax.axis_size(axis_name)
+    me = lax.axis_index(axis_name)
+    if scale is None:
+        scale = 1.0 / (d ** 0.5)
+    do3 = _fold_heads(do).astype(q3.dtype)
+    delta = jnp.sum(do3.astype(jnp.float32) * o3, axis=-1)  # [BH, T_loc]
+    q_off = me * t_loc
+    perm_fwd = [(j, (j - 1) % n) for j in range(n)]
+
+    dq0 = jnp.zeros(q3.shape, jnp.float32)
+    dkv0 = jnp.zeros(k3.shape, jnp.float32)
+
+    def hop(carry, s):
+        dq, k_c, v_c, dk_c, dv_c = carry
+        k_off = ((me + s) % n) * t_loc
+        dq_h, dk_h, dv_h = flash_grads_partial(
+            q3, k_c, v_c, do3, lse, delta, scale, causal,
+            q_off, k_off, block_q, block_k,
+        )
+        dq = dq + dq_h.astype(jnp.float32)
+        dk_c = dk_c + dk_h.astype(jnp.float32)
+        dv_c = dv_c + dv_h.astype(jnp.float32)
+        # dk/dv accumulators travel WITH their k/v shard; after n
+        # rotations every shard (and its gradient) is home
+        k_c = lax.ppermute(k_c, axis_name, perm_fwd)
+        v_c = lax.ppermute(v_c, axis_name, perm_fwd)
+        dk_c = lax.ppermute(dk_c, axis_name, perm_fwd)
+        dv_c = lax.ppermute(dv_c, axis_name, perm_fwd)
+        return (dq, k_c, v_c, dk_c, dv_c), None
+
+    (dq, _, _, dk, dv), _ = lax.scan(
+        hop, (dq0, k3, v3, dkv0, dkv0), jnp.arange(n)
+    )
+    unfold = lambda x3: _unfold_heads(x3, b, h).astype(in_dtype)
+    return unfold(dq), unfold(dk), unfold(dv)
+
+
+ring_flash_attention.defvjp(_ring_flash_vjp_fwd, _ring_flash_vjp_bwd)
+
+
 def full_attention(
     q: jax.Array, k: jax.Array, v: jax.Array, causal: bool = False,
     scale: Optional[float] = None,
@@ -219,16 +363,26 @@ def make_ring_attention(
     axis_name: str = SEQ_AXIS,
     causal: bool = False,
     bidirectional: bool = False,
+    impl: str = "naive",
 ):
     """Jitted sequence-sharded attention: (q, k, v) [B, T, H, D] global ->
-    [B, T, H, D] global, T sharded over the mesh axis."""
-    mapped = jax.shard_map(
-        partial(
+    [B, T, H, D] global, T sharded over the mesh axis.
+
+    impl="flash" uses the Pallas partial-triple kernel per hop
+    (ring_flash_attention; one-way ring only)."""
+    if impl == "flash":
+        if bidirectional:
+            raise ValueError("ring flash supports the one-way ring only")
+        fn = partial(ring_flash_attention, axis_name=axis_name, causal=causal)
+    else:
+        fn = partial(
             ring_attention,
             axis_name=axis_name,
             causal=causal,
             bidirectional=bidirectional,
-        ),
+        )
+    mapped = jax.shard_map(
+        fn,
         mesh=mesh,
         in_specs=(P(None, axis_name), P(None, axis_name), P(None, axis_name)),
         out_specs=P(None, axis_name),

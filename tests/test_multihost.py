@@ -1,0 +1,143 @@
+"""Two-process DCN smoke (VERDICT round-1 item 7).
+
+Spawns TWO real `jax.distributed` processes on localhost (4 virtual CPU
+devices each -> one 8-device job) and drives the actual product CLI:
+
+- hybrid dcn x workers mesh training end to end (cli.train --dcn-hosts 2),
+  both processes running the same command — exactly the tools/
+  run_multihost.sh contract;
+- the multi-host checkpoint path (collective gather, process-0 single
+  writer, durability barrier) producing a file the single-process
+  evaluator can read;
+- mesh-consensus graceful stop: SIGTERM delivered to ONE process stops
+  BOTH at the same step boundary with a checkpoint written (trainer.
+  _stop_consensus) — the capability the reference's tag-77 kill never
+  actually wired (SURVEY.md section 2 straggler row).
+"""
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpu_env import clean_cpu_env  # noqa: E402
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def _spawn(pid: int, port: int, tmp, extra):
+    env = clean_cpu_env(n_devices=4)
+    argv = [
+        sys.executable, "-m", "ps_pytorch_tpu.cli.train",
+        "--coordinator-address", f"localhost:{port}",
+        "--num-processes", "2", "--process-id", str(pid),
+        "--network", "LeNet", "--dataset", "MNIST",
+        "--batch-size", "8", "--lr", "0.05",
+        "--train-dir", str(tmp / "ckpt"),
+        "--metrics-file", str(tmp / f"metrics_{pid}.jsonl"),
+        "--log-interval", "1",
+        *extra,
+    ]
+    return subprocess.Popen(
+        argv, env=env, cwd=REPO,
+        stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+    )
+
+
+def _finish(procs, timeout=420):
+    outs = []
+    deadline = time.monotonic() + timeout
+    for p in procs:
+        try:
+            out, _ = p.communicate(timeout=max(5, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            p.kill()
+            out, _ = p.communicate()
+            pytest.fail(f"2-process run hung; partial output:\n{out[-3000:]}")
+        outs.append(out)
+    return outs
+
+
+@pytest.mark.multihost
+def test_two_process_hybrid_mesh_train_and_checkpoint(tmp_path):
+    port = _free_port()
+    extra = ["--max-steps", "4", "--eval-freq", "2", "--dcn-hosts", "2",
+             "--num-workers", "8"]
+    procs = [_spawn(i, port, tmp_path, extra) for i in (0, 1)]
+    outs = _finish(procs)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"rc={p.returncode}\n{out[-3000:]}"
+        assert "Step: 4" in out, out[-2000:]
+    # single writer, durable on both processes by the time either returns
+    assert (tmp_path / "ckpt" / "model_step_4").exists()
+    # both processes trained the SAME model: identical loss trajectories
+    rows = []
+    for i in (0, 1):
+        with open(tmp_path / f"metrics_{i}.jsonl") as f:
+            rows.append(
+                [json.loads(l)["loss"] for l in f if '"train"' in l]
+            )
+    assert rows[0] == pytest.approx(rows[1]), "processes diverged"
+
+    # the ordinary single-process evaluator consumes the multi-host file
+    ev = subprocess.run(
+        [
+            sys.executable, "-m", "ps_pytorch_tpu.cli.evaluate",
+            "--model-dir", str(tmp_path / "ckpt"),
+            "--network", "LeNet", "--dataset", "MNIST", "--once",
+        ],
+        env=clean_cpu_env(n_devices=1), cwd=REPO,
+        capture_output=True, text=True, timeout=300,
+    )
+    assert ev.returncode == 0, ev.stderr[-2000:]
+    assert "Prec@1" in (ev.stdout + ev.stderr)
+
+
+@pytest.mark.multihost
+def test_sigterm_on_one_process_stops_both(tmp_path):
+    port = _free_port()
+    extra = ["--max-steps", "100000", "--eval-freq", "0", "--dcn-hosts", "2",
+             "--num-workers", "8"]
+    procs = [_spawn(i, port, tmp_path, extra) for i in (0, 1)]
+
+    # wait until BOTH processes are stepping (metrics lines appear), then
+    # signal ONLY process 0 — consensus must stop process 1 too
+    deadline = time.monotonic() + 300
+    while time.monotonic() < deadline:
+        if all(
+            (tmp_path / f"metrics_{i}.jsonl").exists() for i in (0, 1)
+        ):
+            break
+        if any(p.poll() is not None for p in procs):
+            outs = _finish(procs, timeout=10)
+            pytest.fail(f"a process died early:\n{outs[0][-2000:]}\n---\n"
+                        f"{outs[1][-2000:]}")
+        time.sleep(0.5)
+    else:
+        for p in procs:
+            p.kill()
+        pytest.fail("processes never started stepping")
+    procs[0].send_signal(signal.SIGTERM)
+
+    outs = _finish(procs)
+    for p, out in zip(procs, outs):
+        assert p.returncode == 0, f"rc={p.returncode}\n{out[-3000:]}"
+        assert "graceful stop at step" in out, out[-2000:]
+        assert "skipping validation" in out
+    # the post-stop checkpoint was written (resume point)
+    steps = [
+        f for f in os.listdir(tmp_path / "ckpt") if f.startswith("model_step_")
+    ]
+    assert steps, "no checkpoint written on graceful stop"

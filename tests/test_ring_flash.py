@@ -1,0 +1,197 @@
+"""Flash-inside-the-ring vs. the single-device oracle.
+
+ring_flash_attention runs the Pallas partial-triple kernel per ring hop
+(ops/flash_attention.flash_partial / flash_grads_partial) so no shard ever
+materializes a [T_loc, T_loc] score block. It must match full_attention
+exactly (float tolerance) in value AND gradient — same oracle discipline
+as tests/test_ring_attention.py — including through the sequence-parallel
+transformer forward, and Ulysses must match with its local attention
+swapped to the flash kernel.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ps_pytorch_tpu.models.transformer import (
+    TransformerConfig,
+    apply_transformer,
+    init_transformer,
+    make_sp_forward,
+)
+from ps_pytorch_tpu.parallel.ring_attention import (
+    SEQ_AXIS,
+    full_attention,
+    make_ring_attention,
+    make_seq_mesh,
+    ring_flash_attention,
+    shard_sequence,
+)
+from ps_pytorch_tpu.parallel.ulysses import ulysses_attention
+
+B, T, H, D = 2, 64, 4, 16  # T sharded 8 ways -> 8 tokens per device
+
+
+def _qkv(seed=0, dtype=np.float32):
+    rng = np.random.RandomState(seed)
+    mk = lambda: jnp.asarray(rng.randn(B, T, H, D).astype(dtype))
+    return mk(), mk(), mk()
+
+
+@pytest.fixture(scope="module")
+def seq_mesh():
+    return make_seq_mesh(8)
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["bidir", "causal"])
+def test_ring_flash_matches_full(seq_mesh, causal):
+    q, k, v = _qkv()
+    ring = make_ring_attention(seq_mesh, causal=causal, impl="flash")
+    got = ring(
+        shard_sequence(q, seq_mesh),
+        shard_sequence(k, seq_mesh),
+        shard_sequence(v, seq_mesh),
+    )
+    want = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        jax.device_get(got), jax.device_get(want), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["bidir", "causal"])
+def test_ring_flash_gradients_match_full(seq_mesh, causal):
+    q, k, v = _qkv(seed=1)
+
+    def ring_loss(q, k, v):
+        out = jax.shard_map(
+            lambda a, b, c: ring_flash_attention(a, b, c, SEQ_AXIS, causal),
+            mesh=seq_mesh,
+            in_specs=(P(None, SEQ_AXIS),) * 3,
+            out_specs=P(None, SEQ_AXIS),
+            check_vma=False,
+        )(q, k, v)
+        return jnp.sum(out * jnp.cos(out))  # nontrivial cotangent
+
+    def full_loss(q, k, v):
+        out = full_attention(q, k, v, causal=causal)
+        return jnp.sum(out * jnp.cos(out))
+
+    got = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    want = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            jax.device_get(g), jax.device_get(w), rtol=5e-4, atol=5e-5
+        )
+
+
+def test_single_device_ring_flash_is_full_attention():
+    mesh1 = make_seq_mesh(1)
+    q, k, v = _qkv(seed=2)
+    ring = make_ring_attention(mesh1, causal=True, impl="flash")
+    np.testing.assert_allclose(
+        jax.device_get(ring(q, k, v)),
+        jax.device_get(full_attention(q, k, v, causal=True)),
+        rtol=1e-5,
+        atol=1e-5,
+    )
+
+
+def test_ring_flash_bf16_close_to_f32_oracle(seq_mesh):
+    q, k, v = _qkv(seed=3)
+    ring = make_ring_attention(seq_mesh, causal=True, impl="flash")
+    got = ring(
+        shard_sequence(q.astype(jnp.bfloat16), seq_mesh),
+        shard_sequence(k.astype(jnp.bfloat16), seq_mesh),
+        shard_sequence(v.astype(jnp.bfloat16), seq_mesh),
+    )
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        jax.device_get(got).astype(np.float32),
+        jax.device_get(want),
+        rtol=0.06,
+        atol=0.06,
+    )
+
+
+def test_bidirectional_ring_flash_rejected(seq_mesh):
+    with pytest.raises(ValueError, match="one-way"):
+        make_ring_attention(seq_mesh, causal=True, bidirectional=True,
+                            impl="flash")
+
+
+def test_sp_transformer_flash_matches_single_device(seq_mesh):
+    cfg = TransformerConfig(
+        vocab_size=64, dim=64, depth=2, heads=4, max_seq_len=T,
+        attention_impl="flash",
+    )
+    params = init_transformer(cfg, jax.random.key(0))
+    rng = np.random.RandomState(3)
+    tokens = jnp.asarray(rng.randint(0, 64, (B, T)), jnp.int32)
+
+    # oracle: same config WITHOUT sp (single-device flash == full_attention
+    # is covered by tests/test_flash_attention.py; use naive to be safe)
+    oracle_cfg = TransformerConfig(
+        vocab_size=64, dim=64, depth=2, heads=4, max_seq_len=T
+    )
+    want = apply_transformer(oracle_cfg, params, tokens)
+    fwd = make_sp_forward(cfg, seq_mesh)
+    got = fwd(params, shard_sequence(tokens, seq_mesh))
+    np.testing.assert_allclose(
+        jax.device_get(got), jax.device_get(want), rtol=3e-4, atol=3e-4
+    )
+
+
+def test_sp_transformer_flash_trains(seq_mesh):
+    """Gradients flow end-to-end through the ring-flash custom VJP."""
+    cfg = TransformerConfig(
+        vocab_size=32, dim=32, depth=1, heads=2, max_seq_len=T,
+        attention_impl="flash",
+    )
+    params = init_transformer(cfg, jax.random.key(1))
+    rng = np.random.RandomState(4)
+    tokens = jnp.asarray(rng.randint(0, 32, (B, T)), jnp.int32)
+
+    sp_fwd = make_sp_forward(cfg, seq_mesh, jit=False)
+
+    @jax.jit
+    def loss_fn(p, tok):
+        logits = sp_fwd(p, tok)
+        logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+        tgt = tok[:, 1:]
+        return -jnp.mean(jnp.take_along_axis(logp, tgt[..., None], axis=-1))
+
+    tok_sharded = shard_sequence(tokens, seq_mesh)
+    l0, grads = jax.value_and_grad(loss_fn)(params, tok_sharded)
+    params2 = jax.tree_util.tree_map(lambda p, g: p - 0.5 * g, params, grads)
+    l1 = loss_fn(params2, tok_sharded)
+    assert np.isfinite(float(l0)) and float(l1) < float(l0)
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["bidir", "causal"])
+def test_ulysses_flash_matches_full(seq_mesh, causal):
+    # Ulysses needs heads % axis_size == 0 -> 8 heads on the 8-way mesh
+    rng = np.random.RandomState(5)
+    mk = lambda: jnp.asarray(rng.randn(B, T, 8, D).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    ua = jax.jit(
+        jax.shard_map(
+            lambda a, b, c: ulysses_attention(
+                a, b, c, SEQ_AXIS, causal=causal, impl="flash"
+            ),
+            mesh=seq_mesh,
+            in_specs=(P(None, SEQ_AXIS),) * 3,
+            out_specs=P(None, SEQ_AXIS),
+            check_vma=False,
+        )
+    )
+    got = ua(
+        shard_sequence(q, seq_mesh),
+        shard_sequence(k, seq_mesh),
+        shard_sequence(v, seq_mesh),
+    )
+    want = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        jax.device_get(got), jax.device_get(want), rtol=2e-5, atol=2e-5
+    )

@@ -1,9 +1,5 @@
 #!/usr/bin/env bash
-# Tear down the pod slice (parity: the reference's EC2 terminate path in
-# tools/pytorch_ec2.py).
+# Tear down the pod slice (and its queued resource, if QUEUE_NAME is set).
+# Parity: the reference's EC2 terminate path (tools/pytorch_ec2.py).
 set -euo pipefail
-
-TPU_NAME=${TPU_NAME:-ps-tpu-pod}
-ZONE=${ZONE:-us-central2-b}
-
-gcloud compute tpus tpu-vm delete "${TPU_NAME}" --zone="${ZONE}" --quiet
+python "$(dirname "$0")/tpu_cluster.py" ${DRY_RUN:+--dry-run} delete

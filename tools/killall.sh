@@ -1,10 +1,7 @@
 #!/usr/bin/env bash
-# Kill all training processes on every pod host (parity: tools/killall.sh
-# in the reference, which pkill'd python over the ssh mesh).
+# Kill-switch: stop training on every pod host (reference tools/killall.sh
+# + pytorch_ec2.py:841 kill_all_python). Default is graceful SIGTERM — the
+# trainer checkpoints and exits cleanly (resume with --resume); pass
+# --now for SIGKILL.
 set -euo pipefail
-
-TPU_NAME=${TPU_NAME:-ps-tpu-pod}
-ZONE=${ZONE:-us-central2-b}
-
-gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone="${ZONE}" --worker=all \
-  --command="pkill -f ps_pytorch_tpu.cli || true"
+python "$(dirname "$0")/tpu_cluster.py" ${DRY_RUN:+--dry-run} kill "$@"

@@ -1,34 +1,21 @@
 #!/usr/bin/env bash
 # Provision a Cloud TPU pod slice and bootstrap this framework on every host.
-#
-# Role parity with the reference's cluster layer (tools/pytorch_ec2.py:
-# spot-instance launch + NFS + hosts_address generation; remote_script.sh:
-# per-node clone/install) re-targeted at TPU VMs: one gcloud call creates
-# the slice, `--worker=all` fans commands out to every host (replacing the
-# paramiko ssh mesh), and jax.distributed over DCN replaces the hostfile.
+# Thin wrapper over tools/tpu_cluster.py (the full cluster manager: queued/
+# spot resources, preemption recovery, fan-out, kill-switch, gcsfuse —
+# parity map in its module docstring). DRY_RUN=1 prints the gcloud calls.
 #
 # Usage:
 #   TPU_NAME=ps-pod ZONE=us-central2-b ACCEL=v4-32 VERSION=tpu-ubuntu2204-base \
-#     tools/launch_tpu_pod.sh <git-repo-url>
+#     tools/launch_tpu_pod.sh <git-repo-url> [--spot]
 set -euo pipefail
+HERE=$(dirname "$0")
+REPO_URL=${1:?usage: launch_tpu_pod.sh <git-repo-url> [--spot]}
+DRY=${DRY_RUN:+--dry-run}
 
-TPU_NAME=${TPU_NAME:-ps-tpu-pod}
-ZONE=${ZONE:-us-central2-b}
-ACCEL=${ACCEL:-v4-32}
-VERSION=${VERSION:-tpu-ubuntu2204-base}
-REPO_URL=${1:?usage: launch_tpu_pod.sh <git-repo-url>}
-
-echo ">>> creating ${TPU_NAME} (${ACCEL}) in ${ZONE}"
-gcloud compute tpus tpu-vm create "${TPU_NAME}" \
-  --zone="${ZONE}" --accelerator-type="${ACCEL}" --version="${VERSION}"
-
-echo ">>> bootstrapping all hosts"
-gcloud compute tpus tpu-vm ssh "${TPU_NAME}" --zone="${ZONE}" --worker=all \
-  --command="
-    set -e
-    pip install -q 'jax[tpu]' flax optax -f https://storage.googleapis.com/jax-releases/libtpu_releases.html
-    git clone ${REPO_URL} ps_pytorch_tpu_repo || (cd ps_pytorch_tpu_repo && git pull)
-    cd ps_pytorch_tpu_repo && make -C native
-  "
-
+if [ "${2:-}" = "--spot" ]; then
+  python "${HERE}/tpu_cluster.py" ${DRY} launch-queued --spot
+else
+  python "${HERE}/tpu_cluster.py" ${DRY} launch
+fi
+python "${HERE}/tpu_cluster.py" ${DRY} bootstrap "${REPO_URL}"
 echo ">>> done. Train with: tools/run_multihost.sh"

@@ -1,0 +1,125 @@
+"""Materialize REAL image data in the reference's on-disk formats.
+
+This build environment has zero egress, so the canonical MNIST/CIFAR-10
+archives cannot be fetched (cli/prepare_data.py documents the policy).
+The one real image dataset shipped inside the image is scikit-learn's
+bundled UCI handwritten-digits set (1797 genuine 8x8 grayscale scans of
+human-written digits, `sklearn.datasets.load_digits` — public domain).
+This script turns it into drop-in stand-ins for the two datasets the
+reference trains on (/root/reference/src/util.py:21-106):
+
+- MNIST stand-in: digits upscaled to 28x28, written as the four idx
+  files (train-images-idx3-ubyte, ...) that data/datasets._load_mnist
+  reads — the SAME reader a user points at real MNIST.
+- CIFAR-10 stand-in: digits upscaled to 32x32, replicated to RGB,
+  written as the python pickle batches (data_batch_1..5, test_batch)
+  that data/datasets._load_cifar reads.
+
+So the real-data convergence runs exercise the genuine idx/pickle
+readers, the normalization path, and the full trainer/evaluator product
+loop on actual human-written images — the closest possible analogue of
+the reference's de-facto integration test (distributed_evaluator.py:
+90-106 watching Prec@1/Prec@5 climb) that this environment permits.
+
+Usage: python tools/make_real_digits.py [--root DIR] [--test-fraction F]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import pickle
+import struct
+
+import numpy as np
+
+
+def load_digits_split(test_fraction: float, seed: int = 0):
+    from sklearn.datasets import load_digits
+
+    d = load_digits()
+    # pixel values are 0..16; rescale to the 0..255 uint8 range the
+    # readers (and the reference's datasets) use
+    images = np.round(d.images * (255.0 / 16.0)).astype(np.uint8)  # [N, 8, 8]
+    labels = d.target.astype(np.int32)
+
+    # deterministic stratified split so every class appears in both splits
+    rng = np.random.RandomState(seed)
+    train_idx, test_idx = [], []
+    for c in range(10):
+        idx = np.flatnonzero(labels == c)
+        rng.shuffle(idx)
+        n_test = max(1, int(round(len(idx) * test_fraction)))
+        test_idx.extend(idx[:n_test])
+        train_idx.extend(idx[n_test:])
+    train_idx = np.sort(np.asarray(train_idx))
+    test_idx = np.sort(np.asarray(test_idx))
+    return (images[train_idx], labels[train_idx],
+            images[test_idx], labels[test_idx])
+
+
+def upscale(images: np.ndarray, size: int) -> np.ndarray:
+    """[N, 8, 8] uint8 -> [N, size, size] uint8, bilinear."""
+    from scipy.ndimage import zoom
+
+    factor = size / images.shape[1]
+    out = zoom(images.astype(np.float32), (1, factor, factor), order=1)
+    return np.clip(np.round(out), 0, 255).astype(np.uint8)
+
+
+def write_idx(path: str, arr: np.ndarray) -> None:
+    """idx (MNIST) format: >I magic (0x08 = ubyte, ndim in low byte),
+    then big-endian dims, then raw bytes — the format _read_idx parses."""
+    arr = np.ascontiguousarray(arr, np.uint8)
+    with open(path, "wb") as f:
+        f.write(struct.pack(">I", 0x0800 | arr.ndim))
+        f.write(struct.pack(">" + "I" * arr.ndim, *arr.shape))
+        f.write(arr.tobytes())
+
+
+def write_mnist_style(root: str, tr_x, tr_y, te_x, te_y) -> str:
+    d = os.path.join(root, "real_digits_mnist")
+    os.makedirs(d, exist_ok=True)
+    write_idx(os.path.join(d, "train-images-idx3-ubyte"), upscale(tr_x, 28))
+    write_idx(os.path.join(d, "train-labels-idx1-ubyte"), tr_y.astype(np.uint8))
+    write_idx(os.path.join(d, "t10k-images-idx3-ubyte"), upscale(te_x, 28))
+    write_idx(os.path.join(d, "t10k-labels-idx1-ubyte"), te_y.astype(np.uint8))
+    return d
+
+
+def write_cifar_style(root: str, tr_x, tr_y, te_x, te_y) -> str:
+    """CIFAR-10 batch pickles: dict with b"data" [N, 3072] (CHW flat,
+    uint8) and b"labels" — the layout _load_cifar undoes."""
+    d = os.path.join(root, "real_digits_cifar", "cifar-10-batches-py")
+    os.makedirs(d, exist_ok=True)
+
+    def to_batch(x28, y):
+        x = upscale(x28, 32)  # [N, 32, 32]
+        x = np.repeat(x[:, None], 3, axis=1)  # grayscale -> RGB CHW
+        return {b"data": x.reshape(len(x), -1), b"labels": y.tolist()}
+
+    splits = np.array_split(np.arange(len(tr_x)), 5)
+    for i, idx in enumerate(splits, start=1):
+        with open(os.path.join(d, f"data_batch_{i}"), "wb") as f:
+            pickle.dump(to_batch(tr_x[idx], tr_y[idx]), f)
+    with open(os.path.join(d, "test_batch"), "wb") as f:
+        pickle.dump(to_batch(te_x, te_y), f)
+    return d
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(__doc__)
+    p.add_argument("--root", default="./data")
+    p.add_argument("--test-fraction", type=float, default=0.2)
+    args = p.parse_args(argv)
+    tr_x, tr_y, te_x, te_y = load_digits_split(args.test_fraction)
+    m = write_mnist_style(args.root, tr_x, tr_y, te_x, te_y)
+    c = write_cifar_style(args.root, tr_x, tr_y, te_x, te_y)
+    print(f"train={len(tr_x)} test={len(te_x)}")
+    print(f"mnist-style idx  -> {m}  (use PS_TPU_DATA_DIR={m})")
+    print(f"cifar-style pkl  -> {c}  (use PS_TPU_DATA_DIR={os.path.dirname(c)})")
+    return m, c
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,321 @@
+"""TPU pod cluster manager — the reference's EC2 layer re-targeted at Cloud TPU.
+
+Role parity with /root/reference/tools/pytorch_ec2.py (975 lines of boto3 +
+paramiko), subcommand for subcommand:
+
+  reference pytorch_ec2.py            this manager
+  -------------------------------     ------------------------------------
+  launch_instances (:176, spot)    -> launch / launch-queued (--spot)
+  check_instance_state / describe  -> status (detects PREEMPTED/SUSPENDED)
+  spot relaunch-by-hand            -> ensure (recreate when gone/preempted)
+  get_hosts / hosts_address (:656) -> hosts (writes hosts.txt bookkeeping)
+  run_command fan-out (:854)       -> run (gcloud ssh --worker=all)
+  kill_all_python (:841)           -> kill (graceful TERM, --now for KILL)
+  setup_nfs (:880)                 -> mount (gcsfuse a shared bucket on all
+                                      hosts: the checkpoint/evaluator dir)
+  remote_script.sh bootstrap       -> bootstrap (clone + deps on all hosts)
+  terminate path                   -> delete
+
+The ssh mesh disappears: `gcloud compute tpus tpu-vm ssh --worker=all` is
+the fan-out primitive, and jax.distributed over the TPU metadata service
+replaces the mpirun hostfile (tools/run_multihost.sh).
+
+Every subcommand honors --dry-run: print the exact gcloud argv (one per
+line, shell-quoted) WITHOUT executing — this is what CI exercises
+(tests/test_cluster_tools.py), since no cloud project exists in the build
+environment. Config comes from flags or the environment (TPU_NAME, ZONE,
+ACCEL, VERSION, PROJECT), mirroring the reference's cfg dict (:22-91).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import shlex
+import subprocess
+import sys
+import time
+from typing import List, Optional
+
+# ---------------------------------------------------------------- plumbing
+
+
+class GCloud:
+    """Builds (and optionally runs) gcloud invocations. dry_run prints the
+    exact argv instead — the unit-testable surface."""
+
+    def __init__(self, dry_run: bool = False, runner=None):
+        self.dry_run = dry_run
+        self.commands: List[List[str]] = []  # every argv built (tests read this)
+        self._runner = runner or subprocess.run
+
+    def run(self, argv: List[str], check: bool = True, capture: bool = False):
+        self.commands.append(argv)
+        if self.dry_run:
+            print(" ".join(shlex.quote(a) for a in argv))
+            return None
+        return self._runner(
+            argv,
+            check=check,
+            capture_output=capture,
+            text=True,
+        )
+
+
+def _tpu_flags(args) -> List[str]:
+    out = [f"--zone={args.zone}"]
+    if args.project:
+        out.append(f"--project={args.project}")
+    return out
+
+
+def _ssh_all(g: GCloud, args, command: str, check: bool = True):
+    return g.run(
+        [
+            "gcloud", "compute", "tpus", "tpu-vm", "ssh", args.name,
+            *_tpu_flags(args), "--worker=all", f"--command={command}",
+        ],
+        check=check,
+    )
+
+
+# ------------------------------------------------------------- subcommands
+
+
+def cmd_launch(g: GCloud, args):
+    """On-demand slice (reference launch_instances, minus spot)."""
+    g.run(
+        [
+            "gcloud", "compute", "tpus", "tpu-vm", "create", args.name,
+            *_tpu_flags(args),
+            f"--accelerator-type={args.accel}",
+            f"--version={args.version}",
+        ]
+    )
+
+
+def cmd_launch_queued(g: GCloud, args):
+    """Queued resource — the TPU analogue of the reference's SPOT request
+    (pytorch_ec2.py:176 launches spot instances to cut cost; --spot here
+    requests preemptible capacity the same way)."""
+    argv = [
+        "gcloud", "compute", "tpus", "queued-resources", "create",
+        args.queue_name or f"{args.name}-queue",
+        *_tpu_flags(args),
+        f"--node-id={args.name}",
+        f"--accelerator-type={args.accel}",
+        f"--runtime-version={args.version}",
+    ]
+    if args.spot:
+        argv.append("--spot")
+    if args.valid_until:
+        argv.append(f"--valid-until-duration={args.valid_until}")
+    g.run(argv)
+
+
+def cmd_status(g: GCloud, args) -> Optional[str]:
+    """Describe the node; surface the state (READY / PREEMPTED / ...).
+    The reference polls describe_instances the same way to drive its spot
+    bookkeeping."""
+    r = g.run(
+        [
+            "gcloud", "compute", "tpus", "tpu-vm", "describe", args.name,
+            *_tpu_flags(args), "--format=value(state)",
+        ],
+        check=False,
+        capture=True,
+    )
+    if r is None:  # dry run
+        return None
+    state = (r.stdout or "").strip() if r.returncode == 0 else "NOT_FOUND"
+    print(state or "UNKNOWN")
+    return state
+
+
+def cmd_ensure(g: GCloud, args):
+    """Spot/preemption recovery loop body: if the node is missing,
+    PREEMPTED, or SUSPENDED, delete the husk and recreate. Run it from
+    cron/a wrapper loop for hands-off spot training — paired with the
+    trainer's --resume, which picks training back up from the last
+    checkpoint (the recovery story the reference lacked: its spot
+    instances died and stayed dead until relaunched by hand)."""
+    state = cmd_status(g, args)
+    if g.dry_run:
+        # show the recreate path commands too
+        cmd_delete(g, args)
+        cmd_launch(g, args)
+        return
+    if state in (None, "READY", "CREATING"):
+        print(f"ensure: nothing to do (state={state})")
+        return
+    if state != "NOT_FOUND":
+        cmd_delete(g, args)
+    cmd_launch(g, args)
+
+
+def cmd_hosts(g: GCloud, args):
+    """Write the per-host external IPs to --hosts-file (default hosts.txt)
+    — the bookkeeping file parity (reference get_hosts :656 writes
+    hosts/hosts_address for mpirun; jax.distributed needs no hostfile, so
+    this is purely operator-facing inventory)."""
+    r = g.run(
+        [
+            "gcloud", "compute", "tpus", "tpu-vm", "describe", args.name,
+            *_tpu_flags(args),
+            "--format=value(networkEndpoints[].accessConfig.externalIp)",
+        ],
+        capture=True,
+    )
+    if r is None:
+        return
+    ips = [ip for ip in (r.stdout or "").replace(";", "\n").split() if ip]
+    with open(args.hosts_file, "w") as f:
+        f.write("\n".join(ips) + "\n")
+    print(f"{len(ips)} host(s) -> {args.hosts_file}")
+
+
+def cmd_run(g: GCloud, args):
+    """Arbitrary command fan-out to all hosts (reference run_command
+    :854 over paramiko)."""
+    _ssh_all(g, args, args.command)
+
+
+def cmd_kill(g: GCloud, args):
+    """Kill-switch parity (reference kill_all_python :841 + killall.sh):
+    graceful SIGTERM first — the trainer catches it, checkpoints, and
+    exits cleanly (trainer.py graceful-stop path) — or SIGKILL with
+    --now."""
+    sig = "KILL" if args.now else "TERM"
+    _ssh_all(
+        g, args,
+        f"pkill -{sig} -f ps_pytorch_tpu.cli || true",
+        check=False,
+    )
+
+
+def cmd_mount(g: GCloud, args):
+    """Mount a GCS bucket on every host via gcsfuse — the shared
+    train_dir/checkpoint directory the out-of-band evaluator polls
+    (reference setup_nfs :880 exported NFS for exactly this)."""
+    cmdline = (
+        f"sudo mkdir -p {args.mount_point} && "
+        f"(mountpoint -q {args.mount_point} || "
+        f"sudo gcsfuse --implicit-dirs {args.bucket} {args.mount_point})"
+    )
+    _ssh_all(g, args, cmdline)
+
+
+def cmd_bootstrap(g: GCloud, args):
+    """Clone + install on every host (reference remote_script.sh +
+    pre_run.sh: conda/pytorch/blosc/mpi4py mesh install)."""
+    _ssh_all(
+        g, args,
+        "set -e; "
+        "pip install -q 'jax[tpu]' flax optax "
+        "-f https://storage.googleapis.com/jax-releases/libtpu_releases.html; "
+        f"git clone {args.repo_url} ps_pytorch_tpu_repo 2>/dev/null "
+        "|| (cd ps_pytorch_tpu_repo && git pull); "
+        "cd ps_pytorch_tpu_repo && make -C native",
+    )
+
+
+def cmd_delete(g: GCloud, args):
+    g.run(
+        [
+            "gcloud", "compute", "tpus", "tpu-vm", "delete", args.name,
+            *_tpu_flags(args), "--quiet",
+        ],
+        check=False,
+    )
+    if args.queue_name:
+        g.run(
+            [
+                "gcloud", "compute", "tpus", "queued-resources", "delete",
+                args.queue_name, *_tpu_flags(args), "--quiet", "--force",
+            ],
+            check=False,
+        )
+
+
+def cmd_watch(g: GCloud, args):
+    """Poll status every --interval seconds and run `ensure` whenever the
+    node is preempted — the closed-loop spot story (requires a restart
+    wrapper around run_multihost.sh + --resume for full hands-off)."""
+    while True:
+        cmd_ensure(g, args)
+        if g.dry_run:
+            return
+        time.sleep(args.interval)
+
+
+# ------------------------------------------------------------------- main
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "tools/tpu_cluster.py", description=__doc__,
+        formatter_class=argparse.RawDescriptionHelpFormatter,
+    )
+    p.add_argument("--name", default=os.environ.get("TPU_NAME", "ps-tpu-pod"))
+    p.add_argument("--zone", default=os.environ.get("ZONE", "us-central2-b"))
+    p.add_argument("--project", default=os.environ.get("PROJECT", ""))
+    p.add_argument("--accel", default=os.environ.get("ACCEL", "v4-32"))
+    p.add_argument(
+        "--version", default=os.environ.get("VERSION", "tpu-ubuntu2204-base")
+    )
+    p.add_argument("--queue-name", default=os.environ.get("QUEUE_NAME", ""))
+    p.add_argument("--dry-run", action="store_true",
+                   help="print the exact gcloud command(s), execute nothing")
+    sub = p.add_subparsers(dest="cmd", required=True)
+
+    sub.add_parser("launch", help="create an on-demand slice")
+    q = sub.add_parser("launch-queued", help="queued resource (spot parity)")
+    q.add_argument("--spot", action="store_true")
+    q.add_argument("--valid-until", default="",
+                   help="e.g. 6h: give up if not granted in time")
+    sub.add_parser("status", help="print node state")
+    sub.add_parser("ensure", help="recreate if missing/preempted")
+    w = sub.add_parser("watch", help="ensure in a loop")
+    w.add_argument("--interval", type=float, default=60.0)
+    h = sub.add_parser("hosts", help="write per-host IPs (bookkeeping)")
+    h.add_argument("--hosts-file", default="hosts.txt")
+    r = sub.add_parser("run", help="fan a command out to all hosts")
+    r.add_argument("command")
+    k = sub.add_parser("kill", help="stop training on all hosts")
+    k.add_argument("--now", action="store_true", help="SIGKILL instead of TERM")
+    m = sub.add_parser("mount", help="gcsfuse a bucket on all hosts")
+    m.add_argument("bucket")
+    m.add_argument("--mount-point", default="/mnt/ps-ckpt")
+    b = sub.add_parser("bootstrap", help="clone+install on all hosts")
+    b.add_argument("repo_url")
+    sub.add_parser("delete", help="tear the slice (and queue) down")
+    return p
+
+
+HANDLERS = {
+    "launch": cmd_launch,
+    "launch-queued": cmd_launch_queued,
+    "status": cmd_status,
+    "ensure": cmd_ensure,
+    "watch": cmd_watch,
+    "hosts": cmd_hosts,
+    "run": cmd_run,
+    "kill": cmd_kill,
+    "mount": cmd_mount,
+    "bootstrap": cmd_bootstrap,
+    "delete": cmd_delete,
+}
+
+
+def main(argv=None, runner=None) -> GCloud:
+    args = build_parser().parse_args(argv)
+    g = GCloud(dry_run=args.dry_run, runner=runner)
+    HANDLERS[args.cmd](g, args)
+    return g
+
+
+if __name__ == "__main__":
+    try:
+        main()
+    except subprocess.CalledProcessError as e:
+        sys.exit(e.returncode)
