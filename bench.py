@@ -152,23 +152,16 @@ def _mfu(flops_per_step, steps, elapsed, jax, n_devices) -> float | None:
     return round(flops_per_step * steps / elapsed / (peak * n_devices), 4)
 
 
-def _enable_persistent_compile_cache(jax) -> None:
-    """First compile of the big step is ~20-40s on TPU; cache it on disk so
-    repeated bench/driver runs skip straight to steady state."""
-    try:
-        jax.config.update(
-            "jax_compilation_cache_dir",
-            os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/ps_tpu_jax_cache"),
-        )
-        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
-    except Exception:
-        pass  # older jax without these options
 
 
 def main() -> None:
     import jax
 
-    _enable_persistent_compile_cache(jax)
+    from ps_pytorch_tpu.utils import enable_persistent_compile_cache
+
+    # first compile of the big step is ~20-40s on TPU; the disk cache lets
+    # repeated bench/driver runs skip straight to steady state
+    enable_persistent_compile_cache()
 
     from ps_pytorch_tpu.data import IMAGE_SHAPES, make_preprocessor, make_synthetic
     from ps_pytorch_tpu.models import build_model
@@ -346,7 +339,12 @@ if __name__ == "__main__":
         os.environ.get("BENCH_CPU_FALLBACK") == "1"
         or os.environ.get("JAX_PLATFORMS") == "cpu"
     )
-    if not ambient_cpu and not _backend_alive():
+    # the probe exists to catch the ambient TPU plugin HANGING on a dead
+    # tunnel; without the plugin registered (PALLAS_AXON_POOL_IPS unset)
+    # backend init fails fast or succeeds, so skip the probe's extra
+    # backend-init cost on ordinary healthy hosts
+    plugin_present = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+    if not ambient_cpu and plugin_present and not _backend_alive():
         _cpu_fallback_or_error("accelerator backend init failed or hung")
     try:
         main()

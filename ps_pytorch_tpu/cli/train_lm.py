@@ -22,6 +22,8 @@ data/datasets.make_synthetic.
   ... --parallelism tp --heads 8
   ... --parallelism pp --depth 8 --num-microbatches 4
   ... --parallelism moe --num-experts 8
+  ... --parallelism ep_sp --num-shards 4 --num-sp 2 --num-experts 8
+  ... --parallelism pp_moe --num-shards 4 --num-ep 2 --num-experts 8
 """
 
 from __future__ import annotations
@@ -98,7 +100,8 @@ def main(argv=None) -> dict:
     parser.add_argument("--remat", action="store_true")
     parser.add_argument("--bidirectional-ring", action="store_true")
     parser.add_argument("--parallelism", default="dp_sp",
-                        choices=["dp_sp", "dp_tp", "tp", "pp", "moe"])
+                        choices=["dp_sp", "dp_tp", "tp", "pp", "moe",
+                                 "ep_sp", "pp_moe"])
     parser.add_argument("--sp-attention", default="ring",
                         choices=["ring", "ulysses"])
     parser.add_argument("--attention-impl", default="naive",
@@ -113,6 +116,8 @@ def main(argv=None) -> dict:
                         help="pp only: microbatches per step")
     parser.add_argument("--num-experts", type=int, default=8,
                         help="moe only: total experts")
+    parser.add_argument("--num-ep", type=int, default=0,
+                        help="pp_moe: expert-axis size (0 = devices/stages)")
     parser.add_argument("--capacity-factor", type=float, default=1.25,
                         help="moe only: expert capacity factor")
     parser.add_argument("--top-k", type=int, default=1, choices=(1, 2),
@@ -265,6 +270,87 @@ def main(argv=None) -> dict:
         run = lambda p, o, tok: step(p, o, jnp.asarray(tok))
         to_plain = lambda p: from_pp_layout(cfg, p)
         layout = f"pp {n_shards} x {args.num_microbatches} microbatches"
+    elif args.parallelism == "ep_sp":
+        from ..parallel.ep_sp import (
+            init_ep_sp_state,
+            make_ep_sp_train_step,
+            make_mesh_ep_sp,
+            shard_tokens_ep_sp,
+        )
+        from ..parallel.moe import MoEConfig
+
+        num_sp = args.num_sp or 2
+        num_ep = args.num_shards or max(n_dev // num_sp, 1)
+        if args.seq_len % num_sp:
+            raise ValueError(f"--seq-len must be divisible by num_sp={num_sp}")
+        if args.batch_size % num_ep:
+            raise ValueError(
+                f"--batch-size must be divisible by expert shards={num_ep}"
+            )
+        mesh = make_mesh_ep_sp(num_ep, num_sp)
+        moe = MoEConfig(
+            num_experts=args.num_experts,
+            capacity_factor=args.capacity_factor,
+            top_k=args.top_k,
+        )
+        params, opt_state = init_ep_sp_state(cfg, moe, tx, key, mesh)
+        es_step = make_ep_sp_train_step(cfg, moe, tx, mesh)
+        aux_box = {"aux": float("nan")}
+
+        def run(p, o, tok):
+            p, o, loss, aux = es_step(
+                p, o, shard_tokens_ep_sp(jnp.asarray(tok), mesh)
+            )
+            aux_box["aux"] = aux
+            return p, o, loss
+
+        to_plain = lambda p: p
+        layout = (
+            f"ep {num_ep} ({args.num_experts} experts) x sp {num_sp} "
+            f"({args.sp_attention})"
+        )
+    elif args.parallelism == "pp_moe":
+        from ..parallel.moe import MoEConfig
+        from ..parallel.pp_moe import (
+            init_pp_moe_state,
+            make_mesh_pp_moe,
+            make_pp_moe_train_step,
+            shard_tokens_pp_moe,
+        )
+
+        num_ep = args.num_ep or max(n_dev // n_shards, 1)
+        per_col = args.batch_size // num_ep if num_ep else 0
+        if args.batch_size % num_ep or per_col % args.num_microbatches:
+            raise ValueError(
+                f"--batch-size must split over ep={num_ep} then "
+                f"num_microbatches={args.num_microbatches}"
+            )
+        mesh = make_mesh_pp_moe(n_shards, num_ep)
+        moe = MoEConfig(
+            num_experts=args.num_experts,
+            capacity_factor=args.capacity_factor,
+            top_k=args.top_k,
+        )
+        params, opt_state = init_pp_moe_state(cfg, moe, tx, key, mesh)
+        pm_step = make_pp_moe_train_step(
+            cfg, moe, tx, mesh, num_microbatches=args.num_microbatches
+        )
+        aux_box = {"aux": float("nan")}
+
+        def run(p, o, tok):
+            p, o, loss, aux = pm_step(
+                p, o, shard_tokens_pp_moe(jnp.asarray(tok), mesh)
+            )
+            aux_box["aux"] = aux
+            return p, o, loss
+
+        from ..parallel.pp import from_pp_layout as _unstack
+
+        to_plain = lambda p: _unstack(cfg, p)  # plain MoE layout for eval
+        layout = (
+            f"pp {n_shards} x ep {num_ep} ({args.num_experts} experts, "
+            f"{args.num_microbatches} microbatches)"
+        )
     else:  # moe
         from ..parallel.moe import (
             MoEConfig,
@@ -318,7 +404,11 @@ def main(argv=None) -> dict:
                 "params": jax.device_get(to_plain(params)),
                 "step": step_no,
                 "model": {
-                    "kind": "moe" if args.parallelism == "moe" else "dense",
+                    "kind": (
+                        "moe"
+                        if args.parallelism in ("moe", "ep_sp", "pp_moe")
+                        else "dense"
+                    ),
                     "vocab_size": cfg.vocab_size,
                     "dim": cfg.dim,
                     "depth": cfg.depth,
@@ -380,7 +470,7 @@ def main(argv=None) -> dict:
             )
             record = {"kind": "train_lm", "parallelism": args.parallelism,
                       "step": step_no, "loss": loss, "time_cost": round(dt, 6)}
-            if args.parallelism == "moe":
+            if args.parallelism in ("moe", "ep_sp", "pp_moe"):
                 # router balance: aux == 1 is perfectly balanced; a climb
                 # toward num_experts signals expert collapse
                 record["aux_loss"] = round(float(aux_box["aux"]), 6)

@@ -116,6 +116,12 @@ def main(argv=None) -> dict:
     parser.add_argument("--lm-vocab-size", type=int, default=64)
     args = parser.parse_args(argv)
 
+    # sweep candidates re-jit the same step; the persistent cache makes a
+    # re-run of the sweep (and any HLO-identical candidate) compile-free
+    from ..utils import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+
     if args.workload == "lm":
         return tune_lm(args)
 

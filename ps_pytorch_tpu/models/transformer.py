@@ -122,6 +122,53 @@ def local_attention(cfg: TransformerConfig):
     raise ValueError(f"unknown attention_impl {cfg.attention_impl!r}")
 
 
+def select_attention(cfg: TransformerConfig, seq_axis_name: Optional[str] = None):
+    """The attention callable for this config — the ONE selection point.
+
+    seq_axis_name=None: within-chip (naive jnp or Pallas flash).
+    Otherwise: the sequence-parallel scheme (cfg.sp_attention) over that
+    mesh axis — ring (jnp, or flash-per-hop under attention_impl="flash")
+    or Ulysses (a2a re-shard, local attention in cfg.attention_impl).
+    Shared by the dense transformer (apply_transformer) and the MoE
+    transformer (parallel/moe.apply_moe_transformer) so the dense and MoE
+    paths can never diverge in attention math."""
+    if seq_axis_name is None:
+        return local_attention(cfg)
+    if cfg.sp_attention == "ulysses":
+        from ..parallel.ulysses import ulysses_attention
+
+        return partial(
+            ulysses_attention, axis_name=seq_axis_name, causal=cfg.causal,
+            impl=cfg.attention_impl,
+        )
+    if cfg.sp_attention == "ring":
+        if cfg.attention_impl == "flash":
+            if cfg.bidirectional_ring:
+                # refuse rather than silently hand back the
+                # [T_loc, T_loc]-materializing jnp ring the user
+                # explicitly opted out of (make_ring_attention agrees)
+                raise ValueError(
+                    "attention_impl='flash' supports the one-way ring "
+                    "only; unset bidirectional_ring or use naive"
+                )
+            # flash INSIDE each ring hop: no [T_loc, T_loc] block ever
+            # materializes (ops/flash_attention partial-triple kernels)
+            from ..parallel.ring_attention import ring_flash_attention
+
+            return partial(
+                ring_flash_attention,
+                axis_name=seq_axis_name,
+                causal=cfg.causal,
+            )
+        return partial(
+            ring_attention,
+            axis_name=seq_axis_name,
+            causal=cfg.causal,
+            bidirectional=cfg.bidirectional_ring,
+        )
+    raise ValueError(f"unknown sp_attention {cfg.sp_attention!r}")
+
+
 def transformer_block(cfg: TransformerConfig, x, blk, attend, mlp=None):
     """One pre-norm block: attention + GELU MLP, both residual.
 
@@ -166,44 +213,9 @@ def apply_transformer(
     b, t_loc = tokens.shape
     if seq_axis_name is not None:
         shard = jax.lax.axis_index(seq_axis_name) * t_loc
-        if cfg.sp_attention == "ulysses":
-            from ..parallel.ulysses import ulysses_attention
-
-            attend = partial(
-                ulysses_attention, axis_name=seq_axis_name, causal=cfg.causal,
-                impl=cfg.attention_impl,
-            )
-        elif cfg.sp_attention == "ring":
-            if cfg.attention_impl == "flash":
-                if cfg.bidirectional_ring:
-                    # refuse rather than silently hand back the
-                    # [T_loc, T_loc]-materializing jnp ring the user
-                    # explicitly opted out of (make_ring_attention agrees)
-                    raise ValueError(
-                        "attention_impl='flash' supports the one-way ring "
-                        "only; unset bidirectional_ring or use naive"
-                    )
-                # flash INSIDE each ring hop: no [T_loc, T_loc] block ever
-                # materializes (ops/flash_attention partial-triple kernels)
-                from ..parallel.ring_attention import ring_flash_attention
-
-                attend = partial(
-                    ring_flash_attention,
-                    axis_name=seq_axis_name,
-                    causal=cfg.causal,
-                )
-            else:
-                attend = partial(
-                    ring_attention,
-                    axis_name=seq_axis_name,
-                    causal=cfg.causal,
-                    bidirectional=cfg.bidirectional_ring,
-                )
-        else:
-            raise ValueError(f"unknown sp_attention {cfg.sp_attention!r}")
     else:
         shard = 0
-        attend = local_attention(cfg)
+    attend = select_attention(cfg, seq_axis_name)
     if pos_offset is not None:
         shard = shard + pos_offset
     pos = shard + jnp.arange(t_loc)

@@ -1,0 +1,257 @@
+"""3-D parallelism: data x pipeline-stage x tensor(model) on one mesh.
+
+The "compose freely" claim of ARCHITECTURE.md made executable at full
+rank: a (dp, stage, model) mesh where
+
+- the batch shards over `dp` (each dp column runs an independent GPipe
+  schedule over its batch slice; gradients meet in one pmean — the PS
+  aggregation, as everywhere else);
+- block params are PP-stacked [depth, ...] over `stage` AND Megatron-
+  split over `model` (tp.to_tp_layout applied per block before stacking):
+  each (stage, model) device owns depth/n_pp blocks' worth of its own
+  heads / MLP columns;
+- within a tick, every block runs the TP math (two psums over `model`,
+  the innermost / highest-bandwidth axis), activations ppermute over
+  `stage`, microbatches fill the pipeline — three orthogonal collective
+  patterns, one mesh, no new primitive.
+
+Gradient rule (sum-over-shards, as tp/pp/moe): the tick-folded loss is
+replicated across stage x model within a dp column, so differentiate
+local/(n_dp * n_pp * n_tp); then
+  replicated leaves (embeddings, out_norm) -> psum over all three axes,
+  stage-sharded norms -> psum over dp and model,
+  (stage x model)-sharded matrices -> psum over dp only (TP transposes
+  already localized them; PP stages own disjoint depth slices).
+
+No reference counterpart (SURVEY.md section 2: only DP exists there).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional, Sequence
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+from jax import lax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from ..models.transformer import TransformerConfig
+from ..ops.metrics import next_token_nll
+from .mesh import WORKER_AXIS
+from .pp import PP_AXIS
+from .tp import TP_AXIS, opt_state_specs, to_tp_layout
+
+DP_AXIS = WORKER_AXIS
+
+
+def make_mesh_3d(
+    num_dp: int,
+    num_pp: int,
+    num_tp: int,
+    devices: Optional[Sequence[jax.Device]] = None,
+) -> Mesh:
+    """(dp x stage x model); model innermost — the TP psums fire twice per
+    block per tick and must ride the fastest links."""
+    devs = list(devices if devices is not None else jax.devices())
+    need = num_dp * num_pp * num_tp
+    if need > len(devs):
+        raise ValueError(f"need {need} devices, have {len(devs)}")
+    grid = np.array(devs[:need]).reshape(num_dp, num_pp, num_tp)
+    return Mesh(grid, (DP_AXIS, PP_AXIS, TP_AXIS))
+
+
+def to_3d_layout(cfg: TransformerConfig, params: Dict) -> Dict:
+    """Replicated params -> TP layout per block, then PP-stacked."""
+    tp_params = to_tp_layout(cfg, params)
+    out = {k: v for k, v in tp_params.items() if k != "blocks"}
+    out["blocks"] = jax.tree.map(lambda *xs: jnp.stack(xs), *tp_params["blocks"])
+    return out
+
+
+def from_3d_layout(cfg: TransformerConfig, params_3d: Dict) -> Dict:
+    """Inverse of to_3d_layout (checkpoint interchange)."""
+    from .tp import from_tp_layout
+
+    blocks = [
+        jax.tree.map(lambda x: x[i], params_3d["blocks"])
+        for i in range(cfg.depth)
+    ]
+    tp_params = {k: v for k, v in params_3d.items() if k != "blocks"}
+    tp_params["blocks"] = blocks
+    return from_tp_layout(cfg, tp_params)
+
+
+def param_specs_3d(cfg: TransformerConfig) -> Dict:
+    blk = {
+        "ln1": P(PP_AXIS),
+        "wqkv": P(PP_AXIS, None, None, TP_AXIS, None),  # [d, D, 3, H, hd]
+        "wo": P(PP_AXIS, TP_AXIS, None, None),  # [d, H, hd, D]
+        "ln2": P(PP_AXIS),
+        "w_up": P(PP_AXIS, None, TP_AXIS),  # [d, D, M]
+        "w_down": P(PP_AXIS, TP_AXIS, None),  # [d, M, D]
+    }
+    return {"embed": P(), "pos_embed": P(), "out_norm": P(), "blocks": blk}
+
+
+def shard_tokens_3d(tokens, mesh: Mesh):
+    """[B_global, T] -> B over dp (replicated over stage/model)."""
+    return jax.device_put(tokens, NamedSharding(mesh, P(DP_AXIS)))
+
+
+def _tp_block(cfg: TransformerConfig, x, blk, axis_name: str):
+    """One Megatron block on local heads/columns (tp.apply_transformer_tp's
+    block body, reused for stacked-scan consumption)."""
+    from ..models.transformer import _rms_norm, local_attention
+
+    cd = cfg.effective_compute_dtype
+    x = x.astype(cd)
+    blk = {k: v.astype(cd) for k, v in blk.items()}
+    h = _rms_norm(x, blk["ln1"])
+    qkv = jnp.einsum("btd,dchk->btchk", h, blk["wqkv"])
+    q, k, v = qkv[:, :, 0], qkv[:, :, 1], qkv[:, :, 2]
+    o = local_attention(cfg)(q, k, v)
+    proj = jnp.einsum("bthk,hkd->btd", o, blk["wo"])
+    x = x + lax.psum(proj, axis_name)
+    h = _rms_norm(x, blk["ln2"])
+    down = jax.nn.gelu(h @ blk["w_up"]) @ blk["w_down"]
+    return x + lax.psum(down, axis_name)
+
+
+def _3d_loss(cfg: TransformerConfig, params: Dict, tokens: jax.Array):
+    """Tick-folded pipeline loss with TP blocks; tokens [M, B_mb, T] are
+    this dp column's microbatches. Value is replicated across stage and
+    model within the column."""
+    from ..models.transformer import _rms_norm
+
+    n = lax.axis_size(PP_AXIS)
+    stage = lax.axis_index(PP_AXIS)
+    m, b_mb, t = tokens.shape
+    pos = jnp.arange(t)
+    cd = cfg.effective_compute_dtype
+
+    def local_blocks(x):
+        body = lambda x, blk: (_tp_block(cfg, x, blk, TP_AXIS), None)
+        if cfg.remat:
+            body = jax.checkpoint(body)
+        x, _ = lax.scan(body, x, params["blocks"])
+        return x
+
+    def embed(mb_idx):
+        tok = lax.dynamic_index_in_dim(
+            tokens, jnp.clip(mb_idx, 0, m - 1), 0, keepdims=False
+        )
+        return (params["embed"][tok] + params["pos_embed"][pos][None]).astype(cd)
+
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    y0 = jnp.zeros((b_mb, t, cfg.dim), cd)
+
+    def tick(carry, tk):
+        y, loss_sum = carry
+        inbound = lax.ppermute(y, PP_AXIS, perm)
+        x_in = jnp.where(stage == 0, embed(tk), inbound)
+        y_new = local_blocks(x_in)
+        done = tk - (n - 1)
+        tok_mb = lax.dynamic_index_in_dim(
+            tokens, jnp.clip(done, 0, m - 1), 0, keepdims=False
+        )
+        xf = _rms_norm(y_new, params["out_norm"].astype(cd))
+        logits = xf @ params["embed"].T.astype(cd)  # [B_mb, T, V]
+        mb_loss = next_token_nll(logits, tok_mb)
+        loss_sum = loss_sum + jnp.where((done >= 0) & (done < m), mb_loss, 0.0)
+        return (y_new, loss_sum), None
+
+    (_, loss_sum), _ = lax.scan(
+        tick, (y0, jnp.zeros((), jnp.float32)), jnp.arange(m + n - 1)
+    )
+    return lax.psum(jnp.where(stage == n - 1, loss_sum / m, 0.0), PP_AXIS)
+
+
+def make_3d_train_step(
+    cfg: TransformerConfig,
+    tx: optax.GradientTransformation,
+    mesh: Mesh,
+    num_microbatches: int,
+    donate: bool = True,
+):
+    """Jitted dp x pp x tp train step: (params_3d, opt_state, tokens
+    [B_global, T]) -> (params_3d, opt_state, loss)."""
+    specs_tree = param_specs_3d(cfg)
+
+    def shard_fn(params, opt_state, tokens):
+        n_dp = lax.axis_size(DP_AXIS)
+        n_pp = lax.axis_size(PP_AXIS)
+        n_tp = lax.axis_size(TP_AXIS)
+        bsz, t = tokens.shape
+        if bsz % num_microbatches:
+            raise ValueError(
+                f"per-dp batch {bsz} not divisible by "
+                f"{num_microbatches} microbatches"
+            )
+        mb = tokens.reshape(num_microbatches, bsz // num_microbatches, t)
+
+        loss_local, grads = jax.value_and_grad(
+            lambda p: _3d_loss(cfg, p, mb) / (n_dp * n_pp * n_tp)
+        )(params)
+
+        def reduce_grad(g, s):
+            axes = []
+            if DP_AXIS not in s:
+                axes.append(DP_AXIS)
+            if PP_AXIS not in s:
+                axes.append(PP_AXIS)
+            if TP_AXIS not in s:
+                axes.append(TP_AXIS)
+            return lax.psum(g, tuple(axes)) if axes else g
+
+        grads = jax.tree.map(
+            reduce_grad, grads, specs_tree, is_leaf=lambda x: isinstance(x, P)
+        )
+        updates, new_opt = tx.update(grads, opt_state, params)
+        new_params = optax.apply_updates(params, updates)
+        return new_params, new_opt, lax.pmean(loss_local, DP_AXIS) * n_pp * n_tp * n_dp
+
+    from ..models.transformer import init_transformer
+
+    shapes = jax.eval_shape(
+        lambda: to_3d_layout(cfg, init_transformer(cfg, jax.random.key(0)))
+    )
+    opt_specs = opt_state_specs(jax.eval_shape(tx.init, shapes), shapes, specs_tree)
+    mapped = jax.shard_map(
+        shard_fn,
+        mesh=mesh,
+        in_specs=(specs_tree, opt_specs, P(DP_AXIS)),
+        out_specs=(specs_tree, opt_specs, P()),
+        check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0, 1) if donate else ())
+
+
+def init_3d_state(
+    cfg: TransformerConfig,
+    tx: optax.GradientTransformation,
+    key: jax.Array,
+    mesh: Mesh,
+):
+    """Init (params_3d, opt_state) placed for the (dp, stage, model) mesh."""
+    from ..models.transformer import init_transformer
+    from .mesh import place_on_mesh
+
+    if cfg.depth % mesh.shape[PP_AXIS]:
+        raise ValueError(
+            f"depth {cfg.depth} not divisible by {mesh.shape[PP_AXIS]} stages"
+        )
+    n_tp = mesh.shape[TP_AXIS]
+    if cfg.heads % n_tp or (cfg.dim * cfg.mlp_ratio) % n_tp:
+        raise ValueError(
+            f"heads/mlp not divisible by {n_tp} model shards"
+        )
+    specs = param_specs_3d(cfg)
+    params = place_on_mesh(
+        to_3d_layout(cfg, init_transformer(cfg, key)), mesh, specs
+    )
+    opt_state = tx.init(params)
+    return params, place_on_mesh(
+        opt_state, mesh, opt_state_specs(opt_state, params, specs)
+    )

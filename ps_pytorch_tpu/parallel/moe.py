@@ -234,16 +234,30 @@ def apply_moe_transformer(
     cfg: "TransformerConfig",
     moe: MoEConfig,
     params: Dict,
-    tokens: jax.Array,  # int32 [B_local, T]
+    tokens: jax.Array,  # int32 [B_local, T_local]
     axis_name: Optional[str] = None,
+    seq_axis_name: Optional[str] = None,
 ) -> tuple:
-    """Forward -> (logits [B_local, T, vocab], mean aux loss)."""
-    from ..models.transformer import _rms_norm, local_attention, transformer_block
+    """Forward -> (logits [B_local, T_local, vocab], mean aux loss).
+
+    `seq_axis_name` composes expert parallelism with sequence parallelism
+    (parallel/ep_sp.py): attention runs on the ring/Ulysses over that axis
+    and positions index globally, while the MoE dispatch all_to_alls stay
+    on the expert axis — the two collectives touch orthogonal mesh
+    dimensions, so neither needs to know about the other."""
+    from ..models.transformer import (
+        _rms_norm,
+        select_attention,
+        transformer_block,
+    )
 
     b, t = tokens.shape
-    pos = jnp.arange(t)
+    if seq_axis_name is not None:
+        pos = lax.axis_index(seq_axis_name) * t + jnp.arange(t)
+    else:
+        pos = jnp.arange(t)
     x = params["embed"][tokens] + params["pos_embed"][pos][None]
-    attend = local_attention(cfg)
+    attend = select_attention(cfg, seq_axis_name)
 
     def block_fn(x, blk):
         # transformer_block calls mlp(h) exactly once; the cell carries the

@@ -116,6 +116,8 @@ class Trainer:
     def __init__(self, tcfg: TrainConfig, pcfg: PSConfig, dataset: Optional[Dataset] = None):
         self.tcfg, self.pcfg = tcfg, pcfg
         self._stop_requested = False
+        # straggler watchdog event counter (observable --mode action)
+        self.straggler_steps = 0
         self.dataset = dataset or prepare_data(
             tcfg.dataset, root=tcfg.data_root, allow_synthetic=tcfg.allow_synthetic
         )
@@ -339,11 +341,30 @@ class Trainer:
                         and timer.total > t.straggler_threshold_s
                         and step_no != first_step  # compilation step exempt
                     ):
+                        # watchdog ACTION (not just a log line): count the
+                        # event and emit a machine-readable record, so
+                        # --mode's semantics are observable — dashboards /
+                        # the analysis layer aggregate straggler_steps the
+                        # way the reference's notebooks scraped worker
+                        # time-cost distributions. (Killing is meaningless
+                        # under SPMD: there is no per-worker process to
+                        # kill; slow steps indicate input stalls or host
+                        # interference instead.)
+                        self.straggler_steps += 1
                         logger.warning(
                             "straggler step: Step: %d took %.4fs (threshold %.4fs)",
                             step_no,
                             timer.total,
                             t.straggler_threshold_s,
+                        )
+                        append_metrics_line(
+                            t.metrics_file,
+                            {
+                                "kind": "straggler",
+                                "step": step_no,
+                                "time_cost": round(timer.total, 6),
+                                "threshold": t.straggler_threshold_s,
+                            },
                         )
                     if t.log_interval > 0 and (
                         step_no % t.log_interval == 0 or step_no == 1
@@ -411,7 +432,10 @@ class Trainer:
             # checkpoint is durable (or its failure raised) before the
             # caller observes the outcome
             self._ckpt.wait()
-        return {k: float(v) for k, v in metrics.items()}
+        out = {k: float(v) for k, v in metrics.items()}
+        if self.straggler_steps:
+            out["straggler_steps"] = float(self.straggler_steps)
+        return out
 
     # ---------------------------------------------------------------- validate
     def validate(self) -> dict:

@@ -1,6 +1,7 @@
 """Utilities: structured iteration logging (reference-parseable), phase
 timing, and profiler hooks."""
 
+from .compile_cache import enable_persistent_compile_cache
 from .sync import host_sync
 from .logging import (
     ITER_LOG_RE,

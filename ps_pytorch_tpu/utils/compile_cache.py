@@ -1,0 +1,29 @@
+"""Persistent XLA compile cache — shared by bench.py and cli/tune.py.
+
+First compile of a big train step is ~20-40s on TPU; the disk cache makes
+every later process with the same HLO skip straight to steady state. Note
+the cache keys on the HLO hash: a sweep whose candidates differ in a baked
+constant (e.g. tune's lr grid — each lr is folded into the optimizer
+transform) still compiles each DISTINCT candidate once, but re-running the
+same sweep (the common tuning workflow) compiles nothing.
+"""
+
+from __future__ import annotations
+
+import os
+
+
+def enable_persistent_compile_cache(
+    cache_dir: str | None = None,
+) -> None:
+    import jax
+
+    try:
+        jax.config.update(
+            "jax_compilation_cache_dir",
+            cache_dir
+            or os.environ.get("JAX_COMPILATION_CACHE_DIR", "/tmp/ps_tpu_jax_cache"),
+        )
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 1.0)
+    except Exception:
+        pass  # older jax without these options

@@ -54,13 +54,54 @@ def test_status_describe_state():
 
 
 def test_ensure_dry_run_shows_recovery_path():
-    g = run(["ensure"])
+    g = run(["ensure", "--repo-url", "https://x.git"])
     verbs = [(c[3], c[4]) if c[3] != "queued-resources" else (c[3], c[5])
              for c in g.commands]
-    # describe (status), delete, create — the preemption recovery sequence
+    # describe (status), delete, create, wait (describe), bootstrap (ssh)
+    # — the FULL preemption recovery sequence ends on a runnable node
     assert ("tpu-vm", "describe") in verbs
     assert ("tpu-vm", "delete") in verbs
     assert ("tpu-vm", "create") in verbs
+    assert ("tpu-vm", "ssh") in verbs
+    assert "git clone https://x.git" in g.commands[-1][-1]
+
+
+def test_ensure_leaves_transient_states_alone():
+    calls = []
+
+    class R:
+        returncode = 0
+        stdout = "REPAIRING\n"
+
+    def fake_runner(argv, **kw):
+        calls.append(argv)
+        return R()
+
+    g = tpu_cluster.main(
+        ["--name", "p", "--zone", "z", "ensure"], runner=fake_runner
+    )
+    # a node mid-maintenance must NOT be deleted: describe only
+    assert len(g.commands) == 1 and g.commands[0][4] == "describe"
+
+
+def test_wait_ready_polls_until_ready():
+    states = iter(["CREATING", "CREATING", "READY"])
+    calls = []
+
+    def fake_runner(argv, **kw):
+        calls.append(argv)
+
+        class R:
+            returncode = 0
+            stdout = next(states) + "\n"
+
+        return R()
+
+    tpu_cluster.main(
+        ["--name", "p", "--zone", "z", "wait-ready", "--interval", "0.01"],
+        runner=fake_runner,
+    )
+    assert len(calls) == 3
 
 
 def test_run_fans_out_to_all_workers():

@@ -244,6 +244,24 @@ def test_straggler_watchdog_warns(tmp_path, tiny_ds, caplog):
     assert len(warnings) == 2  # steps 2 and 3 (step 1 pays compilation)
 
 
+def test_straggler_watchdog_action_is_observable(tmp_path, tiny_ds):
+    """Beyond the warning line: events are counted in the returned metrics
+    and written to the metrics JSONL (the --mode flag's real semantics)."""
+    import json
+
+    mfile = tmp_path / "metrics.jsonl"
+    tcfg = _tcfg(
+        tmp_path, max_steps=3, save_checkpoints=False,
+        straggler_threshold_s=0.0, metrics_file=str(mfile),
+    )
+    out = Trainer(tcfg, PSConfig(num_workers=2), dataset=tiny_ds).train()
+    assert out["straggler_steps"] == 2.0
+    with open(mfile) as f:
+        events = [json.loads(l) for l in f if '"straggler"' in l]
+    assert [e["step"] for e in events] == [2, 3]
+    assert all(e["threshold"] == 0.0 for e in events)
+
+
 def test_async_checkpointer_visible_after_train(tmp_path, tiny_ds):
     # train() must not return before the last checkpoint is durable
     tcfg = _tcfg(tmp_path, max_steps=5, eval_freq=2)
@@ -318,8 +336,12 @@ def test_cli_train_lm_learns_markov_structure(tmp_path):
         ["--parallelism", "moe", "--num-experts", "8"],
         ["--parallelism", "dp_tp", "--num-dp", "2", "--heads", "4"],
         ["--sp-attention", "ulysses", "--num-dp", "2", "--heads", "8"],
+        ["--parallelism", "ep_sp", "--num-shards", "4", "--num-sp", "2",
+         "--num-experts", "8"],
+        ["--parallelism", "pp_moe", "--num-shards", "4", "--num-ep", "2",
+         "--num-experts", "8", "--depth", "8"],
     ],
-    ids=["tp", "pp", "moe", "dp_tp", "ulysses"],
+    ids=["tp", "pp", "moe", "dp_tp", "ulysses", "ep_sp", "pp_moe"],
 )
 def test_cli_train_lm_parallelism_modes(extra):
     """Every --parallelism scheme trains through the same CLI loop."""
@@ -345,8 +367,12 @@ def test_cli_train_lm_parallelism_modes(extra):
         ["--parallelism", "pp", "--depth", "8"],
         ["--parallelism", "moe", "--num-experts", "8"],
         ["--num-dp", "2"],  # dp_sp default path
+        ["--parallelism", "ep_sp", "--num-shards", "4", "--num-sp", "2",
+         "--num-experts", "8"],
+        ["--parallelism", "pp_moe", "--num-shards", "4", "--num-ep", "2",
+         "--num-experts", "8", "--depth", "8"],
     ],
-    ids=["tp", "pp", "moe", "dp_sp"],
+    ids=["tp", "pp", "moe", "dp_sp", "ep_sp", "pp_moe"],
 )
 def test_cli_train_lm_checkpoint_evaluate_round_trip(tmp_path, extra):
     """Every scheme writes scheme-agnostic checkpoints that the LM

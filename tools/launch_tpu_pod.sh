@@ -17,5 +17,8 @@ if [ "${2:-}" = "--spot" ]; then
 else
   python "${HERE}/tpu_cluster.py" ${DRY} launch
 fi
+# both creates are asynchronous from bootstrap's point of view (a queued/
+# spot grant can take minutes to hours) — block until the node is READY
+python "${HERE}/tpu_cluster.py" ${DRY} wait-ready
 python "${HERE}/tpu_cluster.py" ${DRY} bootstrap "${REPO_URL}"
 echo ">>> done. Train with: tools/run_multihost.sh"

@@ -132,25 +132,61 @@ def cmd_status(g: GCloud, args) -> Optional[str]:
     return state
 
 
+# node states: leave healthy/transient ones alone (deleting a node in a
+# maintenance state would turn a wait into an outage); recreate only the
+# genuinely-dead ones
+_HEALTHY_OR_TRANSIENT = (
+    "READY", "CREATING", "STARTING", "REPAIRING", "RESTARTING", "STOPPING",
+)
+_DEAD = ("PREEMPTED", "SUSPENDED", "TERMINATED", "STOPPED", "NOT_FOUND")
+
+
+def cmd_wait_ready(g: GCloud, args):
+    """Block until the node reports READY (queued/spot grants and fresh
+    creates are asynchronous — bootstrap must not race them). Dry run
+    prints the describe call once and returns."""
+    deadline = time.monotonic() + args.wait_timeout
+    while True:
+        state = cmd_status(g, args)
+        if g.dry_run or state == "READY":
+            return
+        if time.monotonic() > deadline:
+            raise SystemExit(
+                f"wait-ready: node not READY after {args.wait_timeout}s "
+                f"(state={state})"
+            )
+        time.sleep(args.interval)
+
+
 def cmd_ensure(g: GCloud, args):
-    """Spot/preemption recovery loop body: if the node is missing,
-    PREEMPTED, or SUSPENDED, delete the husk and recreate. Run it from
-    cron/a wrapper loop for hands-off spot training — paired with the
-    trainer's --resume, which picks training back up from the last
-    checkpoint (the recovery story the reference lacked: its spot
-    instances died and stayed dead until relaunched by hand)."""
+    """Spot/preemption recovery loop body: if the node is dead (missing,
+    PREEMPTED, SUSPENDED, TERMINATED), delete the husk, recreate, wait
+    for READY, and — when --repo-url is given — re-bootstrap it, so the
+    recovered node is actually runnable. Healthy or TRANSIENT states
+    (CREATING/REPAIRING/RESTARTING...) are left alone: deleting a node
+    mid-maintenance turns a wait into an outage. Run from cron/a wrapper
+    loop for hands-off spot training — paired with the trainer's
+    --resume, which picks training back up from the last checkpoint
+    (the recovery story the reference lacked: its spot instances died
+    and stayed dead until relaunched by hand)."""
     state = cmd_status(g, args)
     if g.dry_run:
-        # show the recreate path commands too
+        # show the full recovery path's commands
         cmd_delete(g, args)
         cmd_launch(g, args)
+        cmd_wait_ready(g, args)
+        if args.repo_url:
+            cmd_bootstrap(g, args)
         return
-    if state in (None, "READY", "CREATING"):
+    if state in _HEALTHY_OR_TRANSIENT:
         print(f"ensure: nothing to do (state={state})")
         return
     if state != "NOT_FOUND":
         cmd_delete(g, args)
     cmd_launch(g, args)
+    cmd_wait_ready(g, args)
+    if args.repo_url:
+        cmd_bootstrap(g, args)
 
 
 def cmd_hosts(g: GCloud, args):
@@ -274,9 +310,15 @@ def build_parser() -> argparse.ArgumentParser:
     q.add_argument("--valid-until", default="",
                    help="e.g. 6h: give up if not granted in time")
     sub.add_parser("status", help="print node state")
-    sub.add_parser("ensure", help="recreate if missing/preempted")
+    wr = sub.add_parser("wait-ready", help="block until the node is READY")
+    e = sub.add_parser("ensure", help="recreate (+rebootstrap) if dead")
+    e.add_argument("--repo-url", default="",
+                   help="re-bootstrap the recreated node from this repo")
     w = sub.add_parser("watch", help="ensure in a loop")
-    w.add_argument("--interval", type=float, default=60.0)
+    w.add_argument("--repo-url", default="")
+    for sp in (wr, e, w):
+        sp.add_argument("--interval", type=float, default=60.0)
+        sp.add_argument("--wait-timeout", type=float, default=3600.0)
     h = sub.add_parser("hosts", help="write per-host IPs (bookkeeping)")
     h.add_argument("--hosts-file", default="hosts.txt")
     r = sub.add_parser("run", help="fan a command out to all hosts")
@@ -296,6 +338,7 @@ HANDLERS = {
     "launch": cmd_launch,
     "launch-queued": cmd_launch_queued,
     "status": cmd_status,
+    "wait-ready": cmd_wait_ready,
     "ensure": cmd_ensure,
     "watch": cmd_watch,
     "hosts": cmd_hosts,

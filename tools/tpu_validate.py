@@ -1,0 +1,222 @@
+"""Compiled-mode Pallas kernel validation + timing on real TPU hardware.
+
+Round-1 verdict weakness #3: every Pallas kernel (flash attention fwd/bwd,
+ring-flash partials, int8 quantizers) was interpret-mode validated only —
+tile/VMEM bugs routinely appear ONLY when compiled. This harness runs the
+kernels COMPILED on the attached accelerator, checks parity against the
+jnp oracles, times them against the naive implementations, and emits one
+JSON report (tools/../runs/tpu_validate.json by default).
+
+Run (real chip):    python tools/tpu_validate.py
+Smoke (CPU, interpret): PS_TPU_PALLAS_INTERPRET=1 JAX_PLATFORMS=cpu \
+                        python tools/tpu_validate.py --seq-lens 256 --quick
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+
+def _time(fn, *args, iters=20, warmup=3):
+    import jax
+
+    from ps_pytorch_tpu.utils import host_sync
+
+    for _ in range(warmup):
+        out = fn(*args)
+    host_sync(out)
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        out = fn(*args)
+    host_sync(out)
+    return (time.perf_counter() - t0) / iters
+
+
+def bench_flash(seq_lens, dtype_name, quick):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ps_pytorch_tpu.ops.flash_attention import flash_attention
+    from ps_pytorch_tpu.parallel.ring_attention import full_attention
+
+    dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+    rows = []
+    for t in seq_lens:
+        b, h, d = (1, 4, 64) if t >= 4096 else (2, 8, 64)
+        rng = np.random.RandomState(t)
+        mk = lambda: jnp.asarray(rng.randn(b, t, h, d), dtype) * 0.5
+        q, k, v = mk(), mk(), mk()
+
+        flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
+        naive = jax.jit(lambda q, k, v: full_attention(q, k, v, causal=True))
+
+        got = jax.device_get(flash(q, k, v)).astype(np.float32)
+        want = jax.device_get(naive(q, k, v)).astype(np.float32)
+        fwd_err = float(np.max(np.abs(got - want)))
+
+        # gradient parity through the custom VJP
+        def loss_flash(q, k, v):
+            o = flash_attention(q, k, v, causal=True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        def loss_naive(q, k, v):
+            o = full_attention(q, k, v, causal=True)
+            return jnp.sum(o.astype(jnp.float32) ** 2)
+
+        gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+        gn = jax.jit(jax.grad(loss_naive, argnums=(0, 1, 2)))
+        bwd_err = max(
+            float(
+                np.max(
+                    np.abs(
+                        jax.device_get(a).astype(np.float32)
+                        - jax.device_get(b_).astype(np.float32)
+                    )
+                )
+            )
+            for a, b_ in zip(gf(q, k, v), gn(q, k, v))
+        )
+
+        iters = 3 if quick else (10 if t >= 4096 else 20)
+        t_flash = _time(flash, q, k, v, iters=iters)
+        t_naive = _time(naive, q, k, v, iters=iters) if t <= 8192 else None
+        tg_flash = _time(lambda *a: gf(*a)[0], q, k, v, iters=iters)
+        tg_naive = _time(lambda *a: gn(*a)[0], q, k, v, iters=iters)
+        rows.append(
+            {
+                "T": t, "B": b, "H": h, "D": d, "dtype": dtype_name,
+                "fwd_max_abs_err": fwd_err,
+                "bwd_max_abs_err": bwd_err,
+                "fwd_ms_flash": round(t_flash * 1e3, 3),
+                "fwd_ms_naive": round(t_naive * 1e3, 3) if t_naive else None,
+                "fwd_speedup": round(t_naive / t_flash, 2) if t_naive else None,
+                "bwd_ms_flash": round(tg_flash * 1e3, 3),
+                "bwd_ms_naive": round(tg_naive * 1e3, 3),
+                "bwd_speedup": round(tg_naive / tg_flash, 2),
+            }
+        )
+        print(f"flash T={t}: {rows[-1]}", flush=True)
+    return rows
+
+
+def bench_quantizers(quick):
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ps_pytorch_tpu.ops import quantize as qz
+
+    rows = []
+    rng = np.random.RandomState(0)
+    for n in ([1 << 20] if quick else [1 << 20, 1 << 24]):
+        x = jnp.asarray(rng.randn(n).astype(np.float32))
+        for name, bs in [("per_tensor", 0), ("per_block_4096", 4096)]:
+            enc = jax.jit(lambda a, b=bs: qz.quantize_int8(a, block_size=b))
+            dec = jax.jit(
+                lambda q, s, b=bs: qz.dequantize_int8(
+                    q, s, block_size=b, shape=x.shape if b else None
+                )
+            )
+            q, scale = enc(x)
+            back = dec(q, scale)
+            err = float(jnp.max(jnp.abs(back - x)))
+            if bs:
+                # per-block error bound: the worst block's absmax / 127
+                bound = float(jnp.max(jnp.abs(scale))) + 1e-7
+            else:
+                bound = float(jnp.max(jnp.abs(x))) / 127.0 + 1e-7
+            t_enc = _time(lambda a: enc(a)[0], x, iters=3 if quick else 30)
+            rows.append(
+                {
+                    "kernel": name, "n": n,
+                    "max_abs_err": err, "err_bound": bound,
+                    "within_bound": err <= bound * 1.01,
+                    "enc_ms": round(t_enc * 1e3, 3),
+                    "GBps": round(4 * n / t_enc / 1e9, 1),
+                }
+            )
+            print(f"quant {name} n={n}: {rows[-1]}", flush=True)
+    return rows
+
+
+def bench_ring_flash(quick):
+    """Single-device ring (n=1 degenerates to flash partials end-to-end):
+    compiled-path sanity for the partial-triple kernels."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from ps_pytorch_tpu.parallel.ring_attention import (
+        full_attention,
+        make_ring_attention,
+        make_seq_mesh,
+    )
+
+    mesh = make_seq_mesh(len(jax.devices()))
+    t = 512 if quick else 2048
+    rng = np.random.RandomState(7)
+    mk = lambda: jnp.asarray(rng.randn(2, t, 4, 64).astype(np.float32)) * 0.5
+    q, k, v = mk(), mk(), mk()
+    ring = make_ring_attention(mesh, causal=True, impl="flash")
+    got = jax.device_get(ring(q, k, v))
+    want = jax.device_get(full_attention(q, k, v, causal=True))
+    err = float(np.max(np.abs(got - want)))
+    row = {"T": t, "devices": len(jax.devices()), "max_abs_err": err}
+    print(f"ring-flash: {row}", flush=True)
+    return [row]
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(__doc__)
+    p.add_argument("--seq-lens", type=int, nargs="+",
+                   default=[1024, 2048, 4096, 8192])
+    p.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16"])
+    p.add_argument("--quick", action="store_true")
+    p.add_argument("--out", default=os.path.join(REPO, "runs", "tpu_validate.json"))
+    args = p.parse_args(argv)
+
+    import jax
+
+    from ps_pytorch_tpu.utils import enable_persistent_compile_cache
+
+    enable_persistent_compile_cache()
+    dev = jax.devices()[0]
+    report = {
+        "platform": dev.platform,
+        "device_kind": getattr(dev, "device_kind", "?"),
+        "interpret_mode": bool(os.environ.get("PS_TPU_PALLAS_INTERPRET")),
+        "flash": [],
+        "ring_flash": [],
+        "quantizers": [],
+    }
+    for dt in args.dtypes:
+        report["flash"] += bench_flash(args.seq_lens, dt, args.quick)
+    report["ring_flash"] = bench_ring_flash(args.quick)
+    report["quantizers"] = bench_quantizers(args.quick)
+
+    # hard gates: parity must hold compiled, not just interpret
+    worst_f32 = max(
+        (r["fwd_max_abs_err"] for r in report["flash"] if r["dtype"] == "float32"),
+        default=0.0,
+    )
+    assert worst_f32 < 2e-4, f"compiled flash f32 parity broken: {worst_f32}"
+    assert all(q["within_bound"] for q in report["quantizers"])
+    assert all(r["max_abs_err"] < 2e-4 for r in report["ring_flash"])
+
+    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    with open(args.out, "w") as f:
+        json.dump(report, f, indent=2)
+    print(f"report -> {args.out}")
+    return report
+
+
+if __name__ == "__main__":
+    main()
