@@ -53,6 +53,23 @@ WORKLOADS = {
 }
 
 
+def _lm_tag() -> str:
+    """The lm metric's shape tag, derived from the SAME BENCH_LM_* envs
+    the workload reads — so success and error records share a key."""
+    tag = (
+        f"d{os.environ.get('BENCH_LM_DIM', 512)}"
+        f"x{os.environ.get('BENCH_LM_DEPTH', 6)}"
+        f"_s{os.environ.get('BENCH_LM_SEQ', 1024)}"
+        f"_b{os.environ.get('BENCH_LM_BATCH', 8)}"
+    )
+    if os.environ.get("BENCH_LM_FLASH") == "1":
+        tag += "_flash"
+    n_sp = int(os.environ.get("BENCH_LM_SP", 1))
+    if n_sp > 1:
+        tag += f"_sp{n_sp}"
+    return tag
+
+
 def _bench_lm(steps: int) -> tuple:
     import jax
     import jax.numpy as jnp
@@ -70,9 +87,13 @@ def _bench_lm(steps: int) -> tuple:
     )
     from ps_pytorch_tpu.utils import host_sync
 
-    # TPU-sized defaults; BENCH_LM_* env overrides shrink for CPU smoke
+    # TPU-sized defaults; BENCH_LM_* env overrides shrink for CPU smoke.
+    # BENCH_LM_FLASH=1 runs the Pallas flash kernel (inside the ring when
+    # BENCH_LM_SP > 1) — the long-context configuration to report on
+    # hardware: e.g. BENCH_LM_SEQ=8192 BENCH_LM_FLASH=1.
     batch = int(os.environ.get("BENCH_LM_BATCH", 8))
     seq = int(os.environ.get("BENCH_LM_SEQ", 1024))
+    n_sp = int(os.environ.get("BENCH_LM_SP", 1))
     cfg = TransformerConfig(
         vocab_size=2048,
         dim=int(os.environ.get("BENCH_LM_DIM", 512)),
@@ -81,8 +102,11 @@ def _bench_lm(steps: int) -> tuple:
         max_seq_len=seq,
         remat=True,
         compute_dtype=jnp.bfloat16,
+        attention_impl=(
+            "flash" if os.environ.get("BENCH_LM_FLASH") == "1" else "naive"
+        ),
     )
-    mesh = make_mesh_2d(1, 1)  # single chip; dp/sp degenerate
+    mesh = make_mesh_2d(1, n_sp)  # single chip default; sp for long context
     tx = sgd(0.01, momentum=0.9)
     params = init_transformer(cfg, jax.random.key(0))
     opt = tx.init(params)
@@ -99,8 +123,7 @@ def _bench_lm(steps: int) -> tuple:
         params, opt, loss = step(params, opt, tok)
     host_sync(params, loss)
     elapsed = time.perf_counter() - t0
-    tag = f"d{cfg.dim}x{cfg.depth}_s{seq}_b{batch}"
-    return batch * seq * steps / elapsed, float(loss), elapsed, tag, flops
+    return batch * seq * steps / elapsed, float(loss), elapsed, _lm_tag(), flops, n_sp
 
 
 # Peak dense matmul FLOP/s per chip by PJRT device_kind substring, used for
@@ -183,7 +206,7 @@ def main() -> None:
     device_kind = getattr(jax.devices()[0], "device_kind", "unknown")
     if name == "lm":
         steps = int(os.environ.get("BENCH_STEPS", 20))
-        tokens_per_sec, loss, elapsed, shape_tag, flops = _bench_lm(steps)
+        tokens_per_sec, loss, elapsed, shape_tag, flops, lm_dev = _bench_lm(steps)
         assert np.isfinite(loss), f"non-finite loss {loss}"
         print(
             json.dumps(
@@ -192,7 +215,7 @@ def main() -> None:
                     "value": round(tokens_per_sec, 1),
                     "unit": "tokens/sec",
                     "vs_baseline": round(tokens_per_sec / REF_IMAGES_PER_SEC, 2),
-                    "mfu": _mfu(flops, steps, elapsed, jax, n_devices=1),
+                    "mfu": _mfu(flops, steps, elapsed, jax, n_devices=lm_dev),
                     "device": device_kind,
                 }
             )
@@ -265,22 +288,30 @@ def main() -> None:
 
 
 def _fallback_env() -> dict:
-    """Clean CPU-only child env (tpu_env scrub) for the labeled fallback."""
+    """Clean CPU-only child env (tpu_env scrub) for the labeled fallback.
+
+    TPU-sized BENCH_LM_* knobs are OVERRIDDEN, not inherited: the
+    fallback is a liveness signal, and the parent's seq-8192/sp-8/flash
+    configuration would crash on the 1-device CPU child (mesh too small)
+    or blow the timeout in kernel interpret mode."""
     env = clean_cpu_env(n_devices=1)
     env["BENCH_CPU_FALLBACK"] = "1"
-    # keep the fallback quick; a CPU number is a liveness signal, not a result
-    env.setdefault("BENCH_STEPS", "5")
+    env["BENCH_STEPS"] = env.get("BENCH_STEPS", "5")
     if os.environ.get("BENCH_WORKLOAD") == "lm":
-        env.setdefault("BENCH_LM_BATCH", "2")
-        env.setdefault("BENCH_LM_SEQ", "256")
-        env.setdefault("BENCH_LM_DIM", "128")
-        env.setdefault("BENCH_LM_DEPTH", "2")
+        env.update(
+            BENCH_LM_BATCH="2", BENCH_LM_SEQ="256", BENCH_LM_DIM="128",
+            BENCH_LM_DEPTH="2", BENCH_LM_SP="1", BENCH_LM_FLASH="0",
+        )
     return env
 
 
 def _emit_error_record(err: str) -> None:
     name = os.environ.get("BENCH_WORKLOAD", "lenet")
-    metric = WORKLOADS.get(name, {}).get("metric") or f"{name}_train_tokens_per_sec"
+    if name == "lm":
+        # same tag construction as the success path => same metric key
+        metric = f"lm_{_lm_tag()}_train_tokens_per_sec"
+    else:
+        metric = WORKLOADS.get(name, {}).get("metric") or f"{name}_train_throughput"
     if os.environ.get("BENCH_CPU_FALLBACK") == "1":
         metric += "_cpu_fallback"  # keep error keys aligned with success keys
     print(

@@ -141,17 +141,6 @@ def main(argv=None) -> dict:
             "(the other schemes keep the embedding replicated and would "
             "silently ignore it)"
         )
-    if (
-        args.attention_impl == "flash"
-        and args.parallelism == "dp_sp"
-        and args.sp_attention == "ring"
-        and args.bidirectional_ring
-    ):
-        raise ValueError(
-            "--attention-impl flash supports the one-way ring only "
-            "(ring_flash_attention); drop --bidirectional-ring or use "
-            "--attention-impl naive"
-        )
     cfg = TransformerConfig(
         vocab_size=args.vocab_size,
         dim=args.dim,

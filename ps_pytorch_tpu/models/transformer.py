@@ -51,8 +51,8 @@ class TransformerConfig:
     # (Pallas blockwise kernel, ops/flash_attention.py). Applies to ALL
     # paths: single-device/tp/pp/moe use it directly; sp "ring" switches
     # to ring_flash_attention (partial-triple kernel per hop, never
-    # [T_loc, T_loc]; one-way ring only) and sp "ulysses" runs it on the
-    # gathered full-seq/local-heads layout
+    # [T_loc, T_loc]; one-way or bidirectional) and sp "ulysses" runs it
+    # on the gathered full-seq/local-heads layout
     attention_impl: str = "naive"
     # mixed precision: params/optimizer state stay `dtype` (keep f32 —
     # bf16 Adam moments are broken: bf16(0.999) == 1.0), while block
@@ -143,22 +143,16 @@ def select_attention(cfg: TransformerConfig, seq_axis_name: Optional[str] = None
         )
     if cfg.sp_attention == "ring":
         if cfg.attention_impl == "flash":
-            if cfg.bidirectional_ring:
-                # refuse rather than silently hand back the
-                # [T_loc, T_loc]-materializing jnp ring the user
-                # explicitly opted out of (make_ring_attention agrees)
-                raise ValueError(
-                    "attention_impl='flash' supports the one-way ring "
-                    "only; unset bidirectional_ring or use naive"
-                )
             # flash INSIDE each ring hop: no [T_loc, T_loc] block ever
-            # materializes (ops/flash_attention partial-triple kernels)
+            # materializes (ops/flash_attention partial-triple kernels);
+            # bidirectional_ring rotates K/V both ways, two triples/hop
             from ..parallel.ring_attention import ring_flash_attention
 
             return partial(
                 ring_flash_attention,
                 axis_name=seq_axis_name,
                 causal=cfg.causal,
+                bidirectional=cfg.bidirectional_ring,
             )
         return partial(
             ring_attention,

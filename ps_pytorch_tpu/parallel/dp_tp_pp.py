@@ -120,15 +120,14 @@ def _tp_block(cfg: TransformerConfig, x, blk, axis_name: str):
 
 
 def _3d_loss(cfg: TransformerConfig, params: Dict, tokens: jax.Array):
-    """Tick-folded pipeline loss with TP blocks; tokens [M, B_mb, T] are
-    this dp column's microbatches. Value is replicated across stage and
-    model within the column."""
+    """Tick-folded pipeline loss (the shared pp.gpipe_fold schedule) with
+    TP blocks; tokens [M, B_mb, T] are this dp column's microbatches.
+    Value is replicated across stage and model within the column."""
     from ..models.transformer import _rms_norm
+    from .pp import gpipe_fold
 
-    n = lax.axis_size(PP_AXIS)
-    stage = lax.axis_index(PP_AXIS)
-    m, b_mb, t = tokens.shape
-    pos = jnp.arange(t)
+    m = tokens.shape[0]
+    pos = jnp.arange(tokens.shape[2])
     cd = cfg.effective_compute_dtype
 
     def local_blocks(x):
@@ -136,7 +135,7 @@ def _3d_loss(cfg: TransformerConfig, params: Dict, tokens: jax.Array):
         if cfg.remat:
             body = jax.checkpoint(body)
         x, _ = lax.scan(body, x, params["blocks"])
-        return x
+        return x, jnp.zeros((), jnp.float32)
 
     def embed(mb_idx):
         tok = lax.dynamic_index_in_dim(
@@ -144,28 +143,15 @@ def _3d_loss(cfg: TransformerConfig, params: Dict, tokens: jax.Array):
         )
         return (params["embed"][tok] + params["pos_embed"][pos][None]).astype(cd)
 
-    perm = [(j, (j + 1) % n) for j in range(n)]
-    y0 = jnp.zeros((b_mb, t, cfg.dim), cd)
-
-    def tick(carry, tk):
-        y, loss_sum = carry
-        inbound = lax.ppermute(y, PP_AXIS, perm)
-        x_in = jnp.where(stage == 0, embed(tk), inbound)
-        y_new = local_blocks(x_in)
-        done = tk - (n - 1)
-        tok_mb = lax.dynamic_index_in_dim(
-            tokens, jnp.clip(done, 0, m - 1), 0, keepdims=False
-        )
-        xf = _rms_norm(y_new, params["out_norm"].astype(cd))
+    def mb_loss(y, tok_mb):
+        xf = _rms_norm(y, params["out_norm"].astype(cd))
         logits = xf @ params["embed"].T.astype(cd)  # [B_mb, T, V]
-        mb_loss = next_token_nll(logits, tok_mb)
-        loss_sum = loss_sum + jnp.where((done >= 0) & (done < m), mb_loss, 0.0)
-        return (y_new, loss_sum), None
+        return next_token_nll(logits, tok_mb)
 
-    (_, loss_sum), _ = lax.scan(
-        tick, (y0, jnp.zeros((), jnp.float32)), jnp.arange(m + n - 1)
+    task, _ = gpipe_fold(
+        PP_AXIS, tokens, cfg.dim, cd, embed, local_blocks, mb_loss
     )
-    return lax.psum(jnp.where(stage == n - 1, loss_sum / m, 0.0), PP_AXIS)
+    return task
 
 
 def make_3d_train_step(

@@ -28,7 +28,7 @@ and psums the replicated leaves' gradients afterwards.
 from __future__ import annotations
 
 from functools import partial
-from typing import Dict, Optional, Sequence, TYPE_CHECKING
+from typing import Callable, Dict, Optional, Sequence, TYPE_CHECKING
 
 import jax
 import jax.numpy as jnp
@@ -105,6 +105,69 @@ def _block(cfg: "TransformerConfig", x, blk):
     return transformer_block(cfg, x, blk, local_attention(cfg))
 
 
+def gpipe_fold(
+    axis_name: str,
+    tokens: jax.Array,  # int32 [M, B_mb, T] microbatched (this column's)
+    dim: int,
+    cd,
+    embed: Callable,  # mb_idx -> [B_mb, T, dim] activations (stage 0)
+    run_local: Callable,  # x -> (y, aux_scalar) through this stage's blocks
+    mb_loss: Callable,  # (y, tok_mb) -> scalar loss for one microbatch
+):
+    """THE GPipe tick schedule — the single implementation shared by the
+    dense pipeline (here), MoE-in-PP (parallel/pp_moe.py), and the 3-D
+    dp x pp x tp composition (parallel/dp_tp_pp.py); only the per-stage
+    block body, embedding, and loss head differ.
+
+    One `lax.scan` over M + S - 1 ticks: every tick each stage ppermutes
+    its previous activation to the next stage, stage 0 injects the next
+    microbatch's embedding, and the loss head is folded INTO the tick per
+    finished microbatch — so the largest activation ever live is one
+    microbatch's [B_mb, T, V] logits, never [M, B_mb, T, V] (a PP stage's
+    memory must scale with the microbatch, not the global batch). The
+    loss value is computed uniformly on every stage (SPMD control flow);
+    only the last stage's survives the mask+psum. `run_local`'s aux
+    output (e.g. MoE load-balance) is accumulated over VALID ticks only —
+    warmup/drain ticks process garbage activations whose statistics must
+    not leak.
+
+    Returns (task_loss, aux_sum): task replicated within the column via
+    the stage psum-mask and already divided by M (mean of equal-size
+    per-microbatch means == global mean); aux_sum is the raw valid-tick
+    sum (normalize at the caller).
+    """
+    n = lax.axis_size(axis_name)
+    stage = lax.axis_index(axis_name)
+    m, b_mb, t = tokens.shape
+    perm = [(j, (j + 1) % n) for j in range(n)]
+    y0 = jnp.zeros((b_mb, t, dim), cd)
+
+    def tick(carry, tk):
+        y, loss_sum, aux_sum = carry
+        inbound = lax.ppermute(y, axis_name, perm)
+        x_in = jnp.where(stage == 0, embed(tk), inbound)
+        y_new, aux_tick = run_local(x_in)
+        mine = tk - stage  # the microbatch THIS stage processed this tick
+        aux_sum = aux_sum + jnp.where(
+            (mine >= 0) & (mine < m), aux_tick, 0.0
+        )
+        done = tk - (n - 1)
+        tok_mb = lax.dynamic_index_in_dim(
+            tokens, jnp.clip(done, 0, m - 1), 0, keepdims=False
+        )
+        loss_sum = loss_sum + jnp.where(
+            (done >= 0) & (done < m), mb_loss(y_new, tok_mb), 0.0
+        )
+        return (y_new, loss_sum, aux_sum), None
+
+    zero = jnp.zeros((), jnp.float32)
+    (_, loss_sum, aux_sum), _ = lax.scan(
+        tick, (y0, zero, zero), jnp.arange(m + n - 1)
+    )
+    task = lax.psum(jnp.where(stage == n - 1, loss_sum / m, 0.0), axis_name)
+    return task, aux_sum
+
+
 def _pp_logits_and_loss(
     cfg: "TransformerConfig",
     params: Dict,  # PP layout, LOCAL shards (inside shard_map)
@@ -115,17 +178,16 @@ def _pp_logits_and_loss(
     (identical on every stage, via psum of the last stage's value)."""
     from ..models.transformer import _rms_norm
 
-    n = lax.axis_size(axis_name)
-    stage = lax.axis_index(axis_name)
-    m, b_mb, t = tokens.shape
-    pos = jnp.arange(t)
+    m = tokens.shape[0]
+    pos = jnp.arange(tokens.shape[2])
+    cd = cfg.effective_compute_dtype  # blocks emit compute_dtype activations
 
     def local_blocks(x):
         body = lambda x, blk: (_block(cfg, x, blk), None)
         if cfg.remat:
             body = jax.checkpoint(body)
         x, _ = lax.scan(body, x, params["blocks"])
-        return x
+        return x, jnp.zeros((), jnp.float32)
 
     def embed(mb_idx):
         tok = lax.dynamic_index_in_dim(
@@ -133,39 +195,15 @@ def _pp_logits_and_loss(
         )
         return (params["embed"][tok] + params["pos_embed"][pos][None]).astype(cd)
 
-    perm = [(j, (j + 1) % n) for j in range(n)]
-    cd = cfg.effective_compute_dtype  # blocks emit compute_dtype activations
-    y0 = jnp.zeros((b_mb, t, cfg.dim), cd)
-
-    # unembed + loss are folded INTO the tick, per finished microbatch, so
-    # the largest activation ever live is one microbatch's [B_mb, T, V]
-    # logits — never [M, B_mb, T, V] (or even [M, B_mb, T, dim]): a PP
-    # stage's memory must scale with the microbatch, not the global batch.
-    # The value is still computed uniformly on every stage (SPMD control
-    # flow); only the last stage's survives the mask+psum.
-    def tick(carry, tk):
-        y, loss_sum = carry
-        inbound = lax.ppermute(y, axis_name, perm)
-        x_in = jnp.where(stage == 0, embed(tk), inbound)
-        y_new = local_blocks(x_in)
-        done = tk - (n - 1)
-        tok_mb = lax.dynamic_index_in_dim(
-            tokens, jnp.clip(done, 0, m - 1), 0, keepdims=False
-        )
-        xf = _rms_norm(y_new, params["out_norm"].astype(cd))
+    def mb_loss(y, tok_mb):
+        xf = _rms_norm(y, params["out_norm"].astype(cd))
         logits = xf @ params["embed"].T.astype(cd)  # [B_mb, T, V]
-        mb_loss = next_token_nll(logits, tok_mb)
-        loss_sum = loss_sum + jnp.where(
-            (done >= 0) & (done < m), mb_loss, 0.0
-        )
-        return (y_new, loss_sum), None
+        return next_token_nll(logits, tok_mb)
 
-    (_, loss_sum), _ = lax.scan(
-        tick, (y0, jnp.zeros((), jnp.float32)), jnp.arange(m + n - 1)
+    task, _ = gpipe_fold(
+        axis_name, tokens, cfg.dim, cd, embed, local_blocks, mb_loss
     )
-    # equal-size microbatches: mean of per-microbatch means == global mean
-    loss_local = loss_sum / m
-    return lax.psum(jnp.where(stage == n - 1, loss_local, 0.0), axis_name)
+    return task
 
 
 def make_pp_train_step(

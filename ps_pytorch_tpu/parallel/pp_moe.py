@@ -82,15 +82,15 @@ def _pp_moe_loss(
     params: Dict,  # PP layout, LOCAL shards
     tokens: jax.Array,  # [M, B_mb_local, T]
 ):
-    """Tick-folded pipeline loss for the MoE transformer; returns
-    (task_loss, aux) — task replicated within a column via the stage
-    psum-mask, aux averaged per valid tick and block."""
+    """Tick-folded pipeline loss for the MoE transformer (the shared
+    pp.gpipe_fold schedule with a MoE block body); returns (task_loss,
+    aux) — task replicated within a column via the stage psum-mask, aux
+    averaged per valid tick and block."""
     from ..models.transformer import _rms_norm, select_attention, transformer_block
+    from .pp import gpipe_fold
 
-    n = lax.axis_size(PP_AXIS)
-    stage = lax.axis_index(PP_AXIS)
-    m, b_mb, t = tokens.shape
-    pos = jnp.arange(t)
+    m = tokens.shape[0]
+    pos = jnp.arange(tokens.shape[2])
     cd = cfg.effective_compute_dtype
     attend = select_attention(cfg, None)
 
@@ -118,34 +118,14 @@ def _pp_moe_loss(
         )
         return (params["embed"][tok] + params["pos_embed"][pos][None]).astype(cd)
 
-    perm = [(j, (j + 1) % n) for j in range(n)]
-    y0 = jnp.zeros((b_mb, t, cfg.dim), cd)
-
-    def tick(carry, tk):
-        y, loss_sum, aux_sum = carry
-        inbound = lax.ppermute(y, PP_AXIS, perm)
-        x_in = jnp.where(stage == 0, embed(tk), inbound)
-        y_new, aux_tick = local_blocks(x_in)
-        # this stage processed microbatch tk - stage this tick (garbage
-        # during warmup/drain) — gate the router stats accordingly
-        mine = tk - stage
-        aux_valid = (mine >= 0) & (mine < m)
-        aux_sum = aux_sum + jnp.where(aux_valid, aux_tick, 0.0)
-        done = tk - (n - 1)
-        tok_mb = lax.dynamic_index_in_dim(
-            tokens, jnp.clip(done, 0, m - 1), 0, keepdims=False
-        )
-        xf = _rms_norm(y_new, params["out_norm"].astype(cd))
+    def mb_loss(y, tok_mb):
+        xf = _rms_norm(y, params["out_norm"].astype(cd))
         logits = xf @ params["embed"].T.astype(cd)  # [B_mb, T, V]
-        mb_loss = next_token_nll(logits, tok_mb)
-        loss_sum = loss_sum + jnp.where((done >= 0) & (done < m), mb_loss, 0.0)
-        return (y_new, loss_sum, aux_sum), None
+        return next_token_nll(logits, tok_mb)
 
-    zero = jnp.zeros((), jnp.float32)
-    (_, loss_sum, aux_sum), _ = lax.scan(
-        tick, (y0, zero, zero), jnp.arange(m + n - 1)
+    task, aux_sum = gpipe_fold(
+        PP_AXIS, tokens, cfg.dim, cd, embed, local_blocks, mb_loss
     )
-    task = lax.psum(jnp.where(stage == n - 1, loss_sum / m, 0.0), PP_AXIS)
     # aux_sum = sum over (valid ticks x local blocks); psum over stages
     # then normalize to mean-per-block-per-microbatch (apply_moe_transformer
     # divides by depth the same way)

@@ -219,7 +219,20 @@ def _merge_triple(acc, hop):
     )
 
 
-@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7))
+_NOOP_M = _NEG_BIG  # a masked hop contributes (pv=0, m=_NEG_BIG, l=0)
+
+
+def _mask_triple(ok, triple):
+    """Reduce a (pv, m, l) hop contribution to a no-op when not ok."""
+    pv, m, l = triple
+    return (
+        jnp.where(ok, pv, 0.0),
+        jnp.where(ok, m, _NOOP_M),
+        jnp.where(ok, l, 0.0),
+    )
+
+
+@partial(jax.custom_vjp, nondiff_argnums=(3, 4, 5, 6, 7, 8))
 def ring_flash_attention(
     q: jax.Array,
     k: jax.Array,
@@ -229,18 +242,37 @@ def ring_flash_attention(
     scale: Optional[float] = None,
     block_q: int = 128,
     block_k: int = 128,
+    bidirectional: bool = False,
 ) -> jax.Array:
     """ring_attention with the Pallas flash kernel inside each hop.
 
     Call inside shard_map with q/k/v sharded [B, T_local, H, D] along
     `axis_name`. Exact (same math as ring_attention/full_attention); falls
     back to kernel interpret mode off-TPU. Memory per hop is O(block_q x
-    block_k) VMEM scratch + the O(T_loc) (pv, m, l) running triple."""
-    o, _ = _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k)
+    block_k) VMEM scratch + the O(T_loc) (pv, m, l) running triple.
+
+    bidirectional=True rotates K/V both ways and merges two partial
+    triples per hop — same total traffic, half the sequential hops, both
+    ICI directions in use (the flash analogue of ring_attention's
+    bidirectional mode; falls back to one-way for n <= 2)."""
+    o, _ = _ring_flash_fwd(
+        q, k, v, axis_name, causal, scale, block_q, block_k, bidirectional
+    )
     return o
 
 
-def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k):
+def _bidir_plan(n):
+    """Offsets 1..n-1 covered by +s (fwd) and -s (bwd) streams; for even n
+    the offset n/2 arrives on both — drop the bwd duplicate."""
+    n_hops = (n - 1 + 1) // 2
+    use_bwd = np.ones(n_hops, bool)
+    if n % 2 == 0 and n_hops:
+        use_bwd[-1] = False
+    return n_hops, use_bwd
+
+
+def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
+                    bidirectional):
     from ..ops.flash_attention import flash_partial
 
     n = lax.axis_size(axis_name)
@@ -256,19 +288,50 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k):
     l0 = jnp.zeros(q3.shape[:2], jnp.float32)
     perm_fwd = [(j, (j - 1) % n) for j in range(n)]
 
-    def hop(carry, s):
-        pv, m, l, k_c, v_c = carry
-        k_off = ((me + s) % n) * t_loc
-        triple = flash_partial(
-            q3, k_c, v_c, scale, causal, q_off, k_off, block_q, block_k
+    def partial_at(k_c, v_c, blk_idx):
+        return flash_partial(
+            q3, k_c, v_c, scale, causal, q_off, blk_idx * t_loc,
+            block_q, block_k,
         )
-        pv, m, l = _merge_triple((pv, m, l), triple)
-        k_c = lax.ppermute(k_c, axis_name, perm_fwd)
-        v_c = lax.ppermute(v_c, axis_name, perm_fwd)
-        return (pv, m, l, k_c, v_c), None
 
-    # k/v come home after n rotations; scan keeps one hop's buffers live
-    (pv, m, l, k3, v3), _ = lax.scan(hop, (pv0, m0, l0, k3, v3), jnp.arange(n))
+    if not bidirectional or n <= 2:
+
+        def hop(carry, s):
+            pv, m, l, k_c, v_c = carry
+            triple = partial_at(k_c, v_c, (me + s) % n)
+            pv, m, l = _merge_triple((pv, m, l), triple)
+            k_c = lax.ppermute(k_c, axis_name, perm_fwd)
+            v_c = lax.ppermute(v_c, axis_name, perm_fwd)
+            return (pv, m, l, k_c, v_c), None
+
+        # k/v come home after n rotations; scan keeps one hop's buffers live
+        (pv, m, l, k3, v3), _ = lax.scan(
+            hop, (pv0, m0, l0, k3, v3), jnp.arange(n)
+        )
+    else:
+        perm_bwd = [(j, (j + 1) % n) for j in range(n)]
+        acc = _merge_triple((pv0, m0, l0), partial_at(k3, v3, me))
+        n_hops, use_bwd = _bidir_plan(n)
+
+        def hop2(carry, xs):
+            s, bwd_ok = xs
+            pv, m, l, k_f, v_f, k_b, v_b = carry
+            k_f = lax.ppermute(k_f, axis_name, perm_fwd)
+            v_f = lax.ppermute(v_f, axis_name, perm_fwd)
+            k_b = lax.ppermute(k_b, axis_name, perm_bwd)
+            v_b = lax.ppermute(v_b, axis_name, perm_bwd)
+            acc = _merge_triple(
+                (pv, m, l), partial_at(k_f, v_f, (me + s) % n)
+            )
+            tb = _mask_triple(bwd_ok, partial_at(k_b, v_b, (me - s) % n))
+            acc = _merge_triple(acc, tb)
+            return (*acc, k_f, v_f, k_b, v_b), None
+
+        (pv, m, l, *_), _ = lax.scan(
+            hop2,
+            (*acc, k3, v3, k3, v3),
+            (jnp.arange(1, n_hops + 1), jnp.asarray(use_bwd)),
+        )
     l_safe = jnp.where(l == 0.0, 1.0, l)
     o3 = pv / l_safe[..., None]
     lse = m + jnp.log(l_safe)
@@ -276,11 +339,15 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k):
     return o, (q3, k3, v3, o3, lse)
 
 
-def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale, block_q, block_k):
-    return _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k)
+def _ring_flash_vjp_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
+                        bidirectional):
+    return _ring_flash_fwd(
+        q, k, v, axis_name, causal, scale, block_q, block_k, bidirectional
+    )
 
 
-def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k, res, do):
+def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k,
+                        bidirectional, res, do):
     from ..ops.flash_attention import flash_grads_partial
 
     q3, k3, v3, o3, lse = res
@@ -298,27 +365,77 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k, res, do):
     dq0 = jnp.zeros(q3.shape, jnp.float32)
     dkv0 = jnp.zeros(k3.shape, jnp.float32)
 
-    def hop(carry, s):
-        dq, k_c, v_c, dk_c, dv_c = carry
-        k_off = ((me + s) % n) * t_loc
-        dq_h, dk_h, dv_h = flash_grads_partial(
+    def grads_at(k_c, v_c, blk_idx):
+        return flash_grads_partial(
             q3, k_c, v_c, do3, lse, delta, scale, causal,
-            q_off, k_off, block_q, block_k,
+            q_off, blk_idx * t_loc, block_q, block_k,
         )
-        dq = dq + dq_h.astype(jnp.float32)
-        dk_c = dk_c + dk_h.astype(jnp.float32)
-        dv_c = dv_c + dv_h.astype(jnp.float32)
-        # dk/dv accumulators travel WITH their k/v shard; after n
-        # rotations every shard (and its gradient) is home
-        k_c = lax.ppermute(k_c, axis_name, perm_fwd)
-        v_c = lax.ppermute(v_c, axis_name, perm_fwd)
-        dk_c = lax.ppermute(dk_c, axis_name, perm_fwd)
-        dv_c = lax.ppermute(dv_c, axis_name, perm_fwd)
-        return (dq, k_c, v_c, dk_c, dv_c), None
 
-    (dq, _, _, dk, dv), _ = lax.scan(
-        hop, (dq0, k3, v3, dkv0, dkv0), jnp.arange(n)
-    )
+    if not bidirectional or n <= 2:
+
+        def hop(carry, s):
+            dq, k_c, v_c, dk_c, dv_c = carry
+            dq_h, dk_h, dv_h = grads_at(k_c, v_c, (me + s) % n)
+            dq = dq + dq_h
+            dk_c = dk_c + dk_h
+            dv_c = dv_c + dv_h
+            # dk/dv accumulators travel WITH their k/v shard; after n
+            # rotations every shard (and its gradient) is home
+            k_c = lax.ppermute(k_c, axis_name, perm_fwd)
+            v_c = lax.ppermute(v_c, axis_name, perm_fwd)
+            dk_c = lax.ppermute(dk_c, axis_name, perm_fwd)
+            dv_c = lax.ppermute(dv_c, axis_name, perm_fwd)
+            return (dq, k_c, v_c, dk_c, dv_c), None
+
+        (dq, _, _, dk, dv), _ = lax.scan(
+            hop, (dq0, k3, v3, dkv0, dkv0), jnp.arange(n)
+        )
+    else:
+        perm_bwd = [(j, (j + 1) % n) for j in range(n)]
+        dq, dk_own, dv_own = grads_at(k3, v3, me)  # own block, no comm
+        n_hops, use_bwd = _bidir_plan(n)
+
+        def hop2(carry, xs):
+            s, bwd_ok = xs
+            dq, k_f, v_f, dk_f, dv_f, k_b, v_b, dk_b, dv_b = carry
+            k_f = lax.ppermute(k_f, axis_name, perm_fwd)
+            v_f = lax.ppermute(v_f, axis_name, perm_fwd)
+            dk_f = lax.ppermute(dk_f, axis_name, perm_fwd)
+            dv_f = lax.ppermute(dv_f, axis_name, perm_fwd)
+            k_b = lax.ppermute(k_b, axis_name, perm_bwd)
+            v_b = lax.ppermute(v_b, axis_name, perm_bwd)
+            dk_b = lax.ppermute(dk_b, axis_name, perm_bwd)
+            dv_b = lax.ppermute(dv_b, axis_name, perm_bwd)
+            dq_f, dkh_f, dvh_f = grads_at(k_f, v_f, (me + s) % n)
+            dq_b, dkh_b, dvh_b = grads_at(k_b, v_b, (me - s) % n)
+            dq = dq + dq_f + jnp.where(bwd_ok, dq_b, 0.0)
+            dk_f = dk_f + dkh_f
+            dv_f = dv_f + dvh_f
+            dk_b = dk_b + jnp.where(bwd_ok, dkh_b, 0.0)
+            dv_b = dv_b + jnp.where(bwd_ok, dvh_b, 0.0)
+            return (dq, k_f, v_f, dk_f, dv_f, k_b, v_b, dk_b, dv_b), None
+
+        (dq, _, _, dk_f, dv_f, _, _, dk_b, dv_b), _ = lax.scan(
+            hop2,
+            (dq, k3, v3, dkv0, dkv0, k3, v3, dkv0, dkv0),
+            (jnp.arange(1, n_hops + 1), jnp.asarray(use_bwd)),
+        )
+        # deliver the traveling accumulators home in ONE rotation each:
+        # after n_hops fwd rotations, device j's fwd accumulator describes
+        # block (j + n_hops) % n -> send to that device; mirror for bwd
+        home_f = [(j, (j + n_hops) % n) for j in range(n)]
+        home_b = [(j, (j - n_hops) % n) for j in range(n)]
+        dk = (
+            dk_own
+            + lax.ppermute(dk_f, axis_name, home_f)
+            + lax.ppermute(dk_b, axis_name, home_b)
+        )
+        dv = (
+            dv_own
+            + lax.ppermute(dv_f, axis_name, home_f)
+            + lax.ppermute(dv_b, axis_name, home_b)
+        )
+
     unfold = lambda x3: _unfold_heads(x3, b, h).astype(in_dtype)
     return unfold(dq), unfold(dk), unfold(dv)
 
@@ -369,11 +486,12 @@ def make_ring_attention(
     [B, T, H, D] global, T sharded over the mesh axis.
 
     impl="flash" uses the Pallas partial-triple kernel per hop
-    (ring_flash_attention; one-way ring only)."""
+    (ring_flash_attention), one-way or bidirectional."""
     if impl == "flash":
-        if bidirectional:
-            raise ValueError("ring flash supports the one-way ring only")
-        fn = partial(ring_flash_attention, axis_name=axis_name, causal=causal)
+        fn = partial(
+            ring_flash_attention, axis_name=axis_name, causal=causal,
+            bidirectional=bidirectional,
+        )
     else:
         fn = partial(
             ring_attention,

@@ -66,6 +66,17 @@ def test_ensure_dry_run_shows_recovery_path():
     assert "git clone https://x.git" in g.commands[-1][-1]
 
 
+def test_ensure_spot_recreates_in_spot_mode():
+    """A preempted spot node must come back as a queued SPOT request (not
+    a silently-on-demand slice), with the stale queue cleaned up first."""
+    g = run(["ensure", "--spot"])
+    flat = [" ".join(c) for c in g.commands]
+    assert any("queued-resources delete podx-queue" in c for c in flat)
+    assert any("queued-resources create podx-queue" in c and "--spot" in c
+               for c in flat)
+    assert not any("tpu-vm create" in c for c in flat)
+
+
 def test_ensure_leaves_transient_states_alone():
     calls = []
 

@@ -115,10 +115,71 @@ def test_ring_flash_bf16_close_to_f32_oracle(seq_mesh):
     )
 
 
-def test_bidirectional_ring_flash_rejected(seq_mesh):
-    with pytest.raises(ValueError, match="one-way"):
-        make_ring_attention(seq_mesh, causal=True, bidirectional=True,
-                            impl="flash")
+@pytest.mark.parametrize("causal", [False, True], ids=["bidir", "causal"])
+def test_bidirectional_ring_flash_matches_full(seq_mesh, causal):
+    # even n=8: exercises the duplicate-offset (n/2) triple masking
+    q, k, v = _qkv(seed=6)
+    ring = make_ring_attention(
+        seq_mesh, causal=causal, bidirectional=True, impl="flash"
+    )
+    got = ring(
+        shard_sequence(q, seq_mesh),
+        shard_sequence(k, seq_mesh),
+        shard_sequence(v, seq_mesh),
+    )
+    want = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        jax.device_get(got), jax.device_get(want), rtol=2e-5, atol=2e-5
+    )
+
+
+@pytest.mark.parametrize("causal", [False, True], ids=["bidir", "causal"])
+def test_bidirectional_ring_flash_gradients_match_full(seq_mesh, causal):
+    """Two counter-rotating dk/dv accumulator streams + the single-hop
+    home delivery must sum to the exact flash backward."""
+    q, k, v = _qkv(seed=7)
+
+    def ring_loss(q, k, v):
+        out = jax.shard_map(
+            lambda a, b, c: ring_flash_attention(
+                a, b, c, SEQ_AXIS, causal, None, 128, 128, True
+            ),
+            mesh=seq_mesh,
+            in_specs=(P(None, SEQ_AXIS),) * 3,
+            out_specs=P(None, SEQ_AXIS),
+            check_vma=False,
+        )(q, k, v)
+        return jnp.sum(out * jnp.cos(out))
+
+    def full_loss(q, k, v):
+        out = full_attention(q, k, v, causal=causal)
+        return jnp.sum(out * jnp.cos(out))
+
+    got = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    want = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(
+            jax.device_get(g), jax.device_get(w), rtol=5e-4, atol=5e-5
+        )
+
+
+def test_bidirectional_ring_flash_odd_n():
+    """Odd axis size: no duplicate offset; both streams fully used."""
+    mesh5 = make_seq_mesh(5)
+    rng = np.random.RandomState(8)
+    mk = lambda: jnp.asarray(rng.randn(2, 40, 4, 16).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+    ring = make_ring_attention(mesh5, causal=True, bidirectional=True,
+                               impl="flash")
+    got = ring(
+        shard_sequence(q, mesh5),
+        shard_sequence(k, mesh5),
+        shard_sequence(v, mesh5),
+    )
+    want = full_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(
+        jax.device_get(got), jax.device_get(want), rtol=2e-5, atol=2e-5
+    )
 
 
 def test_sp_transformer_flash_matches_single_device(seq_mesh):

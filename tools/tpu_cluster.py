@@ -160,7 +160,10 @@ def cmd_wait_ready(g: GCloud, args):
 
 def cmd_ensure(g: GCloud, args):
     """Spot/preemption recovery loop body: if the node is dead (missing,
-    PREEMPTED, SUSPENDED, TERMINATED), delete the husk, recreate, wait
+    PREEMPTED, SUSPENDED, TERMINATED), delete the husk (AND the stale
+    queued resource, so its --node-id cannot conflict), recreate in the
+    SAME provisioning mode it was launched in (--spot => a new queued
+    spot request, not a silently-more-expensive on-demand slice), wait
     for READY, and — when --repo-url is given — re-bootstrap it, so the
     recovered node is actually runnable. Healthy or TRANSIENT states
     (CREATING/REPAIRING/RESTARTING...) are left alone: deleting a node
@@ -169,21 +172,23 @@ def cmd_ensure(g: GCloud, args):
     --resume, which picks training back up from the last checkpoint
     (the recovery story the reference lacked: its spot instances died
     and stayed dead until relaunched by hand)."""
+    if args.spot and not args.queue_name:
+        args.queue_name = f"{args.name}-queue"  # match launch-queued's default
     state = cmd_status(g, args)
-    if g.dry_run:
-        # show the full recovery path's commands
-        cmd_delete(g, args)
+    if not g.dry_run:
+        if state in _HEALTHY_OR_TRANSIENT:
+            print(f"ensure: nothing to do (state={state})")
+            return
+        if state == "NOT_FOUND" and not args.queue_name:
+            pass  # nothing to clean up
+        else:
+            cmd_delete(g, args)
+    else:
+        cmd_delete(g, args)  # dry run: show the full recovery path
+    if args.spot:
+        cmd_launch_queued(g, args)
+    else:
         cmd_launch(g, args)
-        cmd_wait_ready(g, args)
-        if args.repo_url:
-            cmd_bootstrap(g, args)
-        return
-    if state in _HEALTHY_OR_TRANSIENT:
-        print(f"ensure: nothing to do (state={state})")
-        return
-    if state != "NOT_FOUND":
-        cmd_delete(g, args)
-    cmd_launch(g, args)
     cmd_wait_ready(g, args)
     if args.repo_url:
         cmd_bootstrap(g, args)
@@ -312,10 +317,15 @@ def build_parser() -> argparse.ArgumentParser:
     sub.add_parser("status", help="print node state")
     wr = sub.add_parser("wait-ready", help="block until the node is READY")
     e = sub.add_parser("ensure", help="recreate (+rebootstrap) if dead")
-    e.add_argument("--repo-url", default="",
-                   help="re-bootstrap the recreated node from this repo")
     w = sub.add_parser("watch", help="ensure in a loop")
-    w.add_argument("--repo-url", default="")
+    for sp in (e, w):
+        sp.add_argument("--repo-url", default="",
+                        help="re-bootstrap the recreated node from this repo")
+        sp.add_argument("--spot", action="store_true",
+                        help="recreate via a queued SPOT request (keep the "
+                             "original provisioning mode, not on-demand)")
+        sp.add_argument("--valid-until", default="",
+                        help="forwarded to the queued-resource request")
     for sp in (wr, e, w):
         sp.add_argument("--interval", type=float, default=60.0)
         sp.add_argument("--wait-timeout", type=float, default=3600.0)
