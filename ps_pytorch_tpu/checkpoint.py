@@ -156,8 +156,21 @@ def _read_bytes(model_dir: str, step: int) -> bytes:
 
 def load_checkpoint(target, model_dir: str, step: int):
     """Load step N into the structure of `target` (an initialized state).
-    Auto-detects codec-compressed checkpoints."""
-    return serialization.from_bytes(target, _read_bytes(model_dir, step))
+    Auto-detects codec-compressed checkpoints.
+
+    Forward-compat: a top-level field that exists in `target` with value
+    None but is absent from the stored dict (a field added to the state
+    AFTER the checkpoint was written, e.g. PSTrainState.comm_state) is
+    filled with None instead of hard-erroring — old checkpoints stay
+    resumable as long as the new feature is off. A non-None target field
+    still errors loudly (its state genuinely cannot be reconstructed)."""
+    raw = serialization.msgpack_restore(_read_bytes(model_dir, step))
+    tgt_dict = serialization.to_state_dict(target)
+    if isinstance(raw, dict) and isinstance(tgt_dict, dict):
+        for k, v in tgt_dict.items():
+            if k not in raw and v is None:
+                raw[k] = None
+    return serialization.from_state_dict(target, raw)
 
 
 def restore_sharded(target, model_dir: str, step: int, mesh, specs):

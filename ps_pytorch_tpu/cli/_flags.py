@@ -79,8 +79,15 @@ def add_ps_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--mask-mode", type=str, default="random_k",
                         choices=("random_k", "first_k"))
     parser.add_argument("--compress-grad", type=str, default="none",
-                        choices=("compress", "none"),
-                        help="compress -> int8-quantized gradient collectives")
+                        choices=("compress", "none", "2round"),
+                        help="compress -> int8-quantized psum (exact int32 "
+                             "sum); 2round -> all_to_all+all_gather whose "
+                             "WIRE is int8 (true 4x bandwidth cut, one extra "
+                             "bounded quantization on the partial sums)")
+    parser.add_argument("--error-feedback", action="store_true",
+                        help="EF-SGD: carry each worker's compression "
+                             "residual into the next step (needs a "
+                             "--compress-grad mode; replicated placement)")
     parser.add_argument("--quant-block-size", type=int, default=0,
                         help="per-block quantization scale granularity (0 = per-tensor)")
     parser.add_argument("--quant-rounding", type=str, default="nearest",
@@ -141,9 +148,14 @@ def ps_config_from(args: argparse.Namespace, num_workers: int) -> PSConfig:
         num_workers=num_workers,
         num_aggregate=args.num_aggregate or None,
         mask_mode=args.mask_mode,
-        compress="int8" if args.compress_grad == "compress" else None,
+        compress={
+            "compress": "int8",
+            "2round": "int8_2round",
+            "none": None,
+        }[args.compress_grad],
         quant_block_size=args.quant_block_size,
         quant_rounding=args.quant_rounding,
+        error_feedback=args.error_feedback,
         opt_placement=args.opt_placement,
         bn_mode=args.bn_mode,
         grad_accum_steps=args.grad_accum_steps,

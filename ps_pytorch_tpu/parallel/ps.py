@@ -69,9 +69,24 @@ class PSConfig:
     axis_name: Union[str, Tuple[str, ...]] = WORKER_AXIS
     num_aggregate: Optional[int] = None
     mask_mode: str = "random_k"
-    compress: Optional[str] = None  # None | "int8"
+    # None | "int8" (int32-psum of int8 payloads: exact sum, compute-side
+    # compression) | "int8_2round" (all_to_all + requantize + all_gather:
+    # the wire itself carries int8 — a true ~4x bandwidth reduction, one
+    # extra bounded quantization on the partial sums; collectives.
+    # quantized_allreduce_2round)
+    compress: Optional[str] = None
     quant_block_size: int = 0
     quant_rounding: str = "nearest"  # "nearest" | "stochastic" (unbiased)
+    # error feedback (EF-SGD): each worker keeps the residual its
+    # compression dropped and adds it back next step, so quantization
+    # error accumulates into the update instead of being lost — the
+    # standard convergence fix for aggressive compression. Requires a
+    # compress mode; replicated opt_placement only (the ZeRO path
+    # quantizes flat shards; its residual plumbing is future work).
+    # With quant_rounding="stochastic" + "int8_2round" the residual is
+    # approximate (padding changes the noise draw); pair EF with
+    # "nearest" for the exact on-wire residual.
+    error_feedback: bool = False
     opt_placement: str = "replicated"  # "replicated" | "sharded"
     bn_mode: str = "pmean"  # "local" | "pmean" | "synced"
     # microbatches per step, accumulated in an in-step lax.scan: scales the
@@ -104,10 +119,28 @@ class PSConfig:
             raise ValueError(f"bad opt_placement {self.opt_placement!r}")
         if self.bn_mode not in ("local", "pmean", "synced"):
             raise ValueError(f"bad bn_mode {self.bn_mode!r}")
-        if self.compress not in (None, "none", "int8"):
+        if self.compress not in (None, "none", "int8", "int8_2round"):
             raise ValueError(f"bad compress {self.compress!r}")
         if self.quant_rounding not in ("nearest", "stochastic"):
             raise ValueError(f"bad quant_rounding {self.quant_rounding!r}")
+        if self.error_feedback:
+            if self.compress in (None, "none"):
+                raise ValueError("error_feedback needs a compress mode")
+            if self.opt_placement == "sharded":
+                raise ValueError(
+                    "error_feedback is implemented for the replicated "
+                    "placement (ZeRO residual plumbing is future work)"
+                )
+        if self.compress == "int8_2round" and self.opt_placement == "sharded":
+            raise ValueError(
+                "int8_2round applies to the replicated path; the sharded "
+                "placement already reduce-scatters (use compress='int8')"
+            )
+        if self.compress == "int8_2round" and self.dcn_hosts > 1:
+            raise ValueError(
+                "int8_2round is a flat-axis scheme; across DCN use the "
+                "hierarchical quantized psum (compress='int8')"
+            )
 
     @property
     def effective_aggregate(self) -> int:
@@ -122,6 +155,10 @@ class PSTrainState:
     params: Any
     opt_state: Any
     batch_stats: Any
+    # error-feedback residuals, worker-stacked [n, ...] per param leaf
+    # (cfg.error_feedback); None otherwise — checkpointed with the state
+    # so resume keeps the accumulated compression error
+    comm_state: Any = None
 
 
 def _flat_padded_size(params) -> int:
@@ -167,11 +204,19 @@ def init_ps_state(
         batch_stats = tree_map(
             lambda x: jnp.broadcast_to(x, (cfg.num_workers,) + x.shape), batch_stats
         )
+    comm_state = None
+    if cfg.error_feedback:
+        # zero residual per worker per param leaf, worker-stacked
+        comm_state = tree_map(
+            lambda p: jnp.zeros((cfg.num_workers,) + jnp.shape(p), jnp.float32),
+            params,
+        )
     return PSTrainState(
         step=jnp.zeros([], jnp.int32),
         params=params,
         opt_state=opt_state,
         batch_stats=batch_stats,
+        comm_state=comm_state,
     )
 
 
@@ -179,7 +224,13 @@ def state_specs(cfg: PSConfig):
     """PartitionSpecs (pytree prefixes) for PSTrainState components."""
     opt_spec = P(cfg.axis_name) if cfg.opt_placement == "sharded" else P()
     bs_spec = P(cfg.axis_name) if cfg.bn_mode == "local" else P()
-    return PSTrainState(step=P(), params=P(), opt_state=opt_spec, batch_stats=bs_spec)
+    return PSTrainState(
+        step=P(),
+        params=P(),
+        opt_state=opt_spec,
+        batch_stats=bs_spec,
+        comm_state=P(cfg.axis_name),  # worker-stacked residuals (if any)
+    )
 
 
 def shard_state(state: PSTrainState, mesh: Mesh, cfg: PSConfig) -> PSTrainState:
@@ -194,6 +245,7 @@ def shard_state(state: PSTrainState, mesh: Mesh, cfg: PSConfig) -> PSTrainState:
         params=put(state.params, specs.params),
         opt_state=put(state.opt_state, specs.opt_state),
         batch_stats=put(state.batch_stats, specs.batch_stats),
+        comm_state=put(state.comm_state, specs.comm_state),
     )
 
 
@@ -268,7 +320,8 @@ def make_ps_train_step(
     axis, n = cfg.axis_name, cfg.num_workers
     specs = state_specs(cfg)
 
-    def worker_fn(step_idx, params, opt_state, batch_stats, images, labels, key):
+    def worker_fn(step_idx, params, opt_state, batch_stats, comm_state,
+                  images, labels, key):
         w = lax.axis_index(axis)
         k_step = jax.random.fold_in(key, step_idx)
         k_mask = jax.random.fold_in(k_step, 0xA66)
@@ -327,14 +380,26 @@ def make_ps_train_step(
             (loss, (logits, new_bs)), grads = fwd_bwd(bs, x, labels, k_drop)
             prec1, prec5 = accuracy(logits, labels, (1, 5))
 
+        new_comm = comm_state
+        quant_key = (
+            jax.random.fold_in(k_step, 0x5E) if cfg.compress else None
+        )
         if cfg.opt_placement == "sharded":
             params, new_opt = _sharded_ps_update(
                 params, opt_state, grads, tx, cfg, k_mask,
-                quant_key=jax.random.fold_in(k_step, 0x5E) if cfg.compress else None,
+                quant_key=quant_key,
             )
             new_opt = tree_map(lambda a: a[None], new_opt)
         else:
-            agg = aggregate_gradients(
+            if cfg.error_feedback:
+                # EF-SGD: add back last step's compression residual before
+                # transmitting; the new residual is what the wire dropped
+                # — including the ENTIRE gradient on mask-excluded steps
+                # (EF subsumes stale-gradient accumulation for the
+                # backup-worker mode)
+                err = tree_map(lambda a: a[0], comm_state)
+                grads = tree_map(jnp.add, grads, err)
+            out = aggregate_gradients(
                 grads,
                 axis,
                 n,
@@ -344,8 +409,15 @@ def make_ps_train_step(
                 compress=cfg.compress,
                 quant_block_size=cfg.quant_block_size,
                 quant_rounding=cfg.quant_rounding,
-                quant_key=jax.random.fold_in(k_step, 0x5E) if cfg.compress else None,
+                quant_key=quant_key,
+                return_contribution=cfg.error_feedback,
             )
+            if cfg.error_feedback:
+                agg, contribution = out
+                new_err = tree_map(lambda a, b: a - b, grads, contribution)
+                new_comm = tree_map(lambda a: a[None], new_err)
+            else:
+                agg = out
             updates, new_opt = tx.update(agg, opt_state, params)
             params = optax.apply_updates(params, updates)
 
@@ -357,7 +429,7 @@ def make_ps_train_step(
         metrics = lax.pmean(
             {"loss": loss, "prec1": prec1, "prec5": prec5}, axis
         )
-        return params, new_opt, out_bs, metrics
+        return params, new_opt, out_bs, new_comm, metrics
 
     mapped = jax.shard_map(
         worker_fn,
@@ -367,20 +439,28 @@ def make_ps_train_step(
             specs.params,
             specs.opt_state,
             specs.batch_stats,
+            specs.comm_state,
             P(axis),
             P(axis),
             P(),
         ),
-        out_specs=(specs.params, specs.opt_state, specs.batch_stats, P()),
+        out_specs=(
+            specs.params,
+            specs.opt_state,
+            specs.batch_stats,
+            specs.comm_state,
+            P(),
+        ),
         check_vma=False,
     )
 
     def step(state: PSTrainState, batch, key):
-        params, opt_state, batch_stats, metrics = mapped(
+        params, opt_state, batch_stats, comm_state, metrics = mapped(
             state.step,
             state.params,
             state.opt_state,
             state.batch_stats,
+            state.comm_state,
             batch["image"],
             batch["label"],
             key,
@@ -390,6 +470,7 @@ def make_ps_train_step(
             params=params,
             opt_state=opt_state,
             batch_stats=batch_stats,
+            comm_state=comm_state,
         )
         return new_state, metrics
 

@@ -1,0 +1,243 @@
+"""Bandwidth-honest compressed collectives + error feedback.
+
+- quantized_allreduce_2round must approximate the exact mean within the
+  per-block quantization bound, agree on every worker, and round-trip
+  padding for awkward sizes.
+- local_quantized_contribution must satisfy the accounting identity
+  psum(contribution_w) == k * aggregate for the int8 psum path — the
+  invariant that makes error-feedback residuals the TRUE on-wire error.
+- The PS engine with error_feedback must train, carry worker-stacked
+  residuals in PSTrainState.comm_state, checkpoint/resume them, and
+  accumulate the FULL gradient as residual on mask-excluded workers.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ps_pytorch_tpu.models import build_model
+from ps_pytorch_tpu.optim import sgd
+from ps_pytorch_tpu.parallel import (
+    PSConfig,
+    init_ps_state,
+    make_mesh,
+    make_ps_train_step,
+    shard_batch,
+    shard_state,
+)
+from ps_pytorch_tpu.parallel.collectives import (
+    local_quantized_contribution,
+    psum_mean,
+    quantized_allreduce_2round,
+    quantized_psum,
+)
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(num_workers=N, axis_name="workers")
+
+
+def _tree(seed, shapes=((33, 7), (129,), (5, 5, 3))):
+    rng = np.random.RandomState(seed)
+    return [jnp.asarray(rng.randn(*s).astype(np.float32)) for s in shapes]
+
+
+def _run_collective(mesh, fn, tree):
+    """Run `fn(worker_local_tree)` under shard_map with replicated inputs
+    but per-worker scaled values (so workers genuinely differ)."""
+
+    def body(t):
+        w = jax.lax.axis_index("workers").astype(jnp.float32)
+        local = jax.tree.map(lambda g: g * (1.0 + 0.1 * w), t)
+        return fn(local)
+
+    return jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=(P(),), out_specs=P(), check_vma=False
+        )
+    )(tree)
+
+
+@pytest.mark.parametrize("block", [0, 128], ids=["per_tensor", "per_block"])
+def test_2round_close_to_exact_mean(mesh, block):
+    tree = _tree(0)
+    got = _run_collective(
+        mesh,
+        lambda t: quantized_allreduce_2round(
+            t, "workers", float(N), N, block_size=block
+        ),
+        tree,
+    )
+    want = _run_collective(
+        mesh, lambda t: psum_mean(t, "workers", float(N)), tree
+    )
+    for g, w, orig in zip(got, want, tree):
+        # two quantization rounds: error <= (absmax_grad + absmax_sum)/127
+        # per element; bound loosely via the data's scale
+        bound = 2.5 * float(jnp.max(jnp.abs(orig))) * (1.7) / 127.0
+        err = float(jnp.max(jnp.abs(g - w)))
+        assert err <= bound, (err, bound)
+
+
+def test_2round_awkward_sizes(mesh):
+    # sizes that don't divide by workers or blocks: padding must round-trip
+    tree = _tree(1, shapes=((1,), (13,), (257,), (8, 9)))
+    got = _run_collective(
+        mesh,
+        lambda t: quantized_allreduce_2round(
+            t, "workers", float(N), N, block_size=128
+        ),
+        tree,
+    )
+    want = _run_collective(
+        mesh, lambda t: psum_mean(t, "workers", float(N)), tree
+    )
+    for g, w in zip(got, want):
+        assert g.shape == w.shape
+        assert float(jnp.max(jnp.abs(g - w))) < 0.1 * (
+            1 + float(jnp.max(jnp.abs(w)))
+        )
+
+
+@pytest.mark.parametrize("block", [0, 128], ids=["per_tensor", "per_block"])
+def test_contribution_accounting_identity(mesh, block):
+    """psum of per-worker transmitted values == k * quantized_psum result
+    (denominator k) — bit-exact, so EF residuals are the true wire error."""
+    tree = _tree(2)
+
+    def both(t):
+        agg = quantized_psum(t, "workers", float(N), block_size=block)
+        contrib = local_quantized_contribution(t, "workers", block_size=block)
+        contrib_sum = jax.tree.map(
+            lambda c: jax.lax.psum(c, "workers"), contrib
+        )
+        return agg, contrib_sum
+
+    agg, csum = _run_collective(mesh, both, tree)
+    for a, c in zip(agg, csum):
+        np.testing.assert_allclose(
+            np.asarray(a) * N, np.asarray(c), rtol=1e-6, atol=1e-6
+        )
+
+
+def _tiny_setup(mesh, cfg, seed=0):
+    from ps_pytorch_tpu.data import make_preprocessor
+
+    model = build_model("LeNet")
+    tx = sgd(0.05, momentum=0.9)
+    state = init_ps_state(model, tx, cfg, jax.random.key(seed), (28, 28, 1))
+    state = shard_state(state, mesh, cfg)
+    step = make_ps_train_step(
+        model, tx, cfg, mesh, preprocess=make_preprocessor("MNIST", train=False)
+    )
+    rng = np.random.RandomState(seed)
+    batch = shard_batch(
+        {
+            "image": rng.randint(0, 255, (2 * N, 28, 28, 1)).astype(np.uint8),
+            "label": rng.randint(0, 10, (2 * N,)).astype(np.int32),
+        },
+        mesh,
+        cfg,
+    )
+    return state, step, batch
+
+
+@pytest.mark.parametrize("compress", ["int8", "int8_2round"])
+def test_error_feedback_trains_and_carries_residuals(mesh, compress):
+    cfg = PSConfig(
+        num_workers=N, compress=compress, quant_block_size=128,
+        error_feedback=True,
+    )
+    state, step, batch = _tiny_setup(mesh, cfg)
+    assert state.comm_state is not None
+    losses = []
+    for i in range(6):
+        state, metrics = step(state, batch, jax.random.key(i))
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+    # residuals exist, are worker-stacked, and are not all zero
+    leaves = jax.tree_util.tree_leaves(state.comm_state)
+    assert all(l.shape[0] == N for l in leaves)
+    assert any(float(jnp.max(jnp.abs(l))) > 0 for l in leaves)
+
+
+def test_error_feedback_accumulates_masked_gradients(mesh):
+    """With first_k masking, excluded workers transmit nothing — their
+    residual must hold their ENTIRE (feedback-corrected) gradient."""
+    cfg = PSConfig(
+        num_workers=N, compress="int8", num_aggregate=2,
+        mask_mode="first_k", error_feedback=True,
+    )
+    state, step, batch = _tiny_setup(mesh, cfg, seed=3)
+    state, _ = step(state, batch, jax.random.key(0))
+    leaves = jax.tree_util.tree_leaves(state.comm_state)
+    # masked-out workers (idx >= 2) carry much larger residuals than the
+    # transmitting ones (theirs is just int8 rounding error)
+    for l in leaves:
+        l = np.asarray(jax.device_get(l))
+        excluded = np.abs(l[2:]).max()
+        included = np.abs(l[:2]).max()
+        if excluded > 0:  # leaves with zero grads (e.g. last-layer bias) skip
+            assert excluded >= included, (excluded, included)
+
+
+def test_error_feedback_state_checkpoints(mesh, tmp_path):
+    from ps_pytorch_tpu.checkpoint import load_checkpoint, save_checkpoint
+
+    cfg = PSConfig(num_workers=N, compress="int8", error_feedback=True)
+    state, step, batch = _tiny_setup(mesh, cfg, seed=4)
+    state, _ = step(state, batch, jax.random.key(0))
+    save_checkpoint(state, str(tmp_path), 1)
+
+    cfg2 = PSConfig(num_workers=N, compress="int8", error_feedback=True)
+    fresh = init_ps_state(
+        build_model("LeNet"), sgd(0.05, momentum=0.9), cfg2,
+        jax.random.key(9), (28, 28, 1),
+    )
+    restored = load_checkpoint(fresh, str(tmp_path), 1)
+    for a, b in zip(
+        jax.tree_util.tree_leaves(restored.comm_state),
+        jax.tree_util.tree_leaves(jax.device_get(state.comm_state)),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_pre_comm_state_checkpoints_still_resume(mesh, tmp_path):
+    """Checkpoints written BEFORE PSTrainState gained comm_state (their
+    state dict has no such key) must restore into a comm_state=None
+    target — the forward-compat shim in checkpoint.load_checkpoint."""
+    from flax import serialization
+
+    from ps_pytorch_tpu.checkpoint import load_checkpoint
+
+    cfg = PSConfig(num_workers=N)  # no EF: comm_state is None
+    state = init_ps_state(
+        build_model("LeNet"), sgd(0.05), cfg, jax.random.key(0), (28, 28, 1)
+    )
+    old_dict = serialization.to_state_dict(jax.device_get(state))
+    old_dict.pop("comm_state")  # simulate the pre-feature format
+    (tmp_path / "model_step_7").write_bytes(
+        serialization.msgpack_serialize(old_dict)
+    )
+    restored = load_checkpoint(state, str(tmp_path), 7)
+    assert restored.comm_state is None
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(restored.step)),
+        np.asarray(jax.device_get(state.step)),
+    )
+
+
+def test_config_validation():
+    with pytest.raises(ValueError, match="needs a compress"):
+        PSConfig(num_workers=4, error_feedback=True)
+    with pytest.raises(ValueError, match="replicated"):
+        PSConfig(num_workers=4, compress="int8", error_feedback=True,
+                 opt_placement="sharded")
+    with pytest.raises(ValueError, match="replicated|sharded"):
+        PSConfig(num_workers=4, compress="int8_2round", opt_placement="sharded")
