@@ -53,20 +53,26 @@ WORKLOADS = {
 }
 
 
+# one source for the lm workload's env-overridable defaults, consumed by
+# BOTH _bench_lm and _lm_tag so success and error records share a metric key
+_LM_DEFAULTS = {"BATCH": 8, "SEQ": 1024, "DIM": 512, "DEPTH": 6, "SP": 1}
+
+
+def _lm_env(name: str) -> int:
+    return int(os.environ.get(f"BENCH_LM_{name}", _LM_DEFAULTS[name]))
+
+
 def _lm_tag() -> str:
     """The lm metric's shape tag, derived from the SAME BENCH_LM_* envs
-    the workload reads — so success and error records share a key."""
+    (and defaults) the workload reads."""
     tag = (
-        f"d{os.environ.get('BENCH_LM_DIM', 512)}"
-        f"x{os.environ.get('BENCH_LM_DEPTH', 6)}"
-        f"_s{os.environ.get('BENCH_LM_SEQ', 1024)}"
-        f"_b{os.environ.get('BENCH_LM_BATCH', 8)}"
+        f"d{_lm_env('DIM')}x{_lm_env('DEPTH')}"
+        f"_s{_lm_env('SEQ')}_b{_lm_env('BATCH')}"
     )
     if os.environ.get("BENCH_LM_FLASH") == "1":
         tag += "_flash"
-    n_sp = int(os.environ.get("BENCH_LM_SP", 1))
-    if n_sp > 1:
-        tag += f"_sp{n_sp}"
+    if _lm_env("SP") > 1:
+        tag += f"_sp{_lm_env('SP')}"
     return tag
 
 
@@ -91,13 +97,13 @@ def _bench_lm(steps: int) -> tuple:
     # BENCH_LM_FLASH=1 runs the Pallas flash kernel (inside the ring when
     # BENCH_LM_SP > 1) — the long-context configuration to report on
     # hardware: e.g. BENCH_LM_SEQ=8192 BENCH_LM_FLASH=1.
-    batch = int(os.environ.get("BENCH_LM_BATCH", 8))
-    seq = int(os.environ.get("BENCH_LM_SEQ", 1024))
-    n_sp = int(os.environ.get("BENCH_LM_SP", 1))
+    batch = _lm_env("BATCH")
+    seq = _lm_env("SEQ")
+    n_sp = _lm_env("SP")
     cfg = TransformerConfig(
         vocab_size=2048,
-        dim=int(os.environ.get("BENCH_LM_DIM", 512)),
-        depth=int(os.environ.get("BENCH_LM_DEPTH", 6)),
+        dim=_lm_env("DIM"),
+        depth=_lm_env("DEPTH"),
         heads=8,
         max_seq_len=seq,
         remat=True,

@@ -194,6 +194,12 @@ def generate(
     vs single-token decode); the scan then covers only the last prompt
     token plus the generated region.
     """
+    if not cfg.causal:
+        # the KV-cache decode attends causally by construction
+        # (_attend_cached masks pos >= length regardless of cfg.causal),
+        # and the batched prefill follows cfg.causal — a non-causal config
+        # would silently diverge between the two, so refuse it loudly
+        raise ValueError("generate() is autoregressive: cfg.causal must be True")
     b, t_prompt = prompt.shape
     L = max_len or cfg.max_seq_len
     total = t_prompt + max_new_tokens
