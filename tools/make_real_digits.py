@@ -14,6 +14,11 @@ reference trains on (/root/reference/src/util.py:21-106):
 - CIFAR-10 stand-in: digits upscaled to 32x32, replicated to RGB,
   written as the python pickle batches (data_batch_1..5, test_batch)
   that data/datasets._load_cifar reads.
+- CIFAR-100 stand-in: same images in the cifar-100-python/ train+test
+  pickle layout (fine_labels; 10 real classes of the 100 label space).
+- SVHN stand-in: same images as train_32x32.mat / test_32x32.mat
+  (scipy.io, HWCN layout, labels 10 -> digit 0 as in the real SVHN)
+  for data/datasets._load_svhn.
 
 So the real-data convergence runs exercise the genuine idx/pickle
 readers, the normalization path, and the full trainer/evaluator product
@@ -107,6 +112,42 @@ def write_cifar_style(root: str, tr_x, tr_y, te_x, te_y) -> str:
     return d
 
 
+def write_cifar100_style(root: str, tr_x, tr_y, te_x, te_y) -> str:
+    """cifar-100-python/{train,test} pickles with b"fine_labels"."""
+    d = os.path.join(root, "real_digits_cifar100", "cifar-100-python")
+    os.makedirs(d, exist_ok=True)
+
+    def to_split(x28, y):
+        x = np.repeat(upscale(x28, 32)[:, None], 3, axis=1)  # CHW RGB
+        return {b"data": x.reshape(len(x), -1), b"fine_labels": y.tolist()}
+
+    with open(os.path.join(d, "train"), "wb") as f:
+        pickle.dump(to_split(tr_x, tr_y), f)
+    with open(os.path.join(d, "test"), "wb") as f:
+        pickle.dump(to_split(te_x, te_y), f)
+    return d
+
+
+def write_svhn_style(root: str, tr_x, tr_y, te_x, te_y) -> str:
+    """train_32x32.mat / test_32x32.mat: X is HWCN uint8, y 1..10 with
+    10 == digit 0 (the real SVHN label quirk _load_svhn undoes)."""
+    import scipy.io
+
+    d = os.path.join(root, "real_digits_svhn")
+    os.makedirs(d, exist_ok=True)
+
+    def to_mat(path, x28, y):
+        x = np.repeat(upscale(x28, 32)[..., None], 3, axis=3)  # NHWC
+        y_svhn = np.where(y == 0, 10, y).astype(np.uint8).reshape(-1, 1)
+        scipy.io.savemat(
+            path, {"X": x.transpose(1, 2, 3, 0), "y": y_svhn}
+        )
+
+    to_mat(os.path.join(d, "train_32x32.mat"), tr_x, tr_y)
+    to_mat(os.path.join(d, "test_32x32.mat"), te_x, te_y)
+    return d
+
+
 def main(argv=None):
     p = argparse.ArgumentParser(__doc__)
     p.add_argument("--root", default="./data")
@@ -115,10 +156,14 @@ def main(argv=None):
     tr_x, tr_y, te_x, te_y = load_digits_split(args.test_fraction)
     m = write_mnist_style(args.root, tr_x, tr_y, te_x, te_y)
     c = write_cifar_style(args.root, tr_x, tr_y, te_x, te_y)
+    c100 = write_cifar100_style(args.root, tr_x, tr_y, te_x, te_y)
+    s = write_svhn_style(args.root, tr_x, tr_y, te_x, te_y)
     print(f"train={len(tr_x)} test={len(te_x)}")
-    print(f"mnist-style idx  -> {m}  (use PS_TPU_DATA_DIR={m})")
-    print(f"cifar-style pkl  -> {c}  (use PS_TPU_DATA_DIR={os.path.dirname(c)})")
-    return m, c
+    print(f"mnist-style idx   -> {m}  (use PS_TPU_DATA_DIR={m})")
+    print(f"cifar-style pkl   -> {c}  (use PS_TPU_DATA_DIR={os.path.dirname(c)})")
+    print(f"cifar100-style    -> {c100}  (use PS_TPU_DATA_DIR={os.path.dirname(c100)})")
+    print(f"svhn-style mat    -> {s}  (use PS_TPU_DATA_DIR={s})")
+    return m, c, c100, s
 
 
 if __name__ == "__main__":
