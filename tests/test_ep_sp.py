@@ -132,6 +132,27 @@ def test_ep_sp_training_decreases_loss(mesh):
     assert w.addressable_shards[0].data.shape[0] == moe.num_experts // N_EP
 
 
+def test_ep_sp_bf16_remat_trains(mesh):
+    """Mixed precision + remat through ring attention AND the expert
+    all_to_alls: finite, decreasing loss; params stay f32."""
+    cfg = TransformerConfig(
+        vocab_size=61, dim=32, depth=2, heads=4, max_seq_len=16,
+        remat=True, compute_dtype=jnp.bfloat16,
+    )
+    moe = MoEConfig(num_experts=8, capacity_factor=2.0)
+    tx = sgd(0.3, momentum=0.9)
+    params, opt_state = init_ep_sp_state(cfg, moe, tx, jax.random.key(6), mesh)
+    step = make_ep_sp_train_step(cfg, moe, tx, mesh)
+    tokens = shard_tokens_ep_sp(_tokens(6, b=16), mesh)
+    losses = []
+    for _ in range(8):
+        params, opt_state, loss, _aux = step(params, opt_state, tokens)
+        losses.append(float(loss))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+    assert params["blocks"][0]["w_up_e"].dtype == jnp.float32
+
+
 def test_ep_sp_loss_slices_sum_to_global_mean(mesh):
     """The local objective slices psum'd over sp and pmean'd over ep must
     equal the oracle's global mean NLL (roomy capacity)."""
