@@ -19,6 +19,7 @@ the measured config).
 
 import json
 import os
+import re
 import subprocess
 import sys
 import time
@@ -132,28 +133,32 @@ def _bench_lm(steps: int) -> tuple:
     return batch * seq * steps / elapsed, float(loss), elapsed, _lm_tag(), flops, n_sp
 
 
-# Peak dense matmul FLOP/s per chip by PJRT device_kind substring, used for
-# the MFU field. bf16 peaks (the compute dtype of every workload here); from
-# public TPU spec sheets. Matched case-insensitively, first hit wins.
-_PEAK_FLOPS = [
-    ("v6", 918e12),        # Trillium
-    ("v5p", 459e12),
-    ("v5 lite", 197e12),   # v5e; device_kind "TPU v5 lite"
-    ("v5e", 197e12),
-    ("v4", 275e12),
-    ("v3", 123e12),
-    ("v2", 45e12),
-]
+# Peak dense matmul FLOP/s per chip keyed by exact (generation, variant)
+# parsed out of the PJRT device_kind. bf16 peaks (the compute dtype of every
+# workload here); from public TPU spec sheets. Unlisted kinds (e.g. a future
+# "v6p") return None — MFU is omitted rather than misattributed to another
+# generation's peak.
+_PEAK_BY_GEN = {
+    ("6", "e"): 918e12,    # Trillium; device_kind "TPU v6e"/"TPU v6 lite"
+    ("5", "p"): 459e12,
+    ("5", "e"): 197e12,    # v5e; device_kind "TPU v5 lite"
+    ("4", ""): 275e12,
+    ("3", ""): 123e12,
+    ("2", ""): 45e12,
+}
 
 
 def _peak_flops_per_sec(device) -> float | None:
     kind = getattr(device, "device_kind", "").lower()
     if "tpu" not in kind:
         return None  # CPU fallback: MFU is meaningless, omit
-    for sub, peak in _PEAK_FLOPS:
-        if sub in kind:
-            return peak
-    return None
+    m = re.search(r"v(\d+) ?(p\b|e\b|lite\b)?", kind)
+    if not m:
+        return None
+    variant = m.group(2) or ""
+    if variant == "lite":
+        variant = "e"
+    return _PEAK_BY_GEN.get((m.group(1), variant))
 
 
 def _step_flops(step, *args) -> float | None:
@@ -386,7 +391,9 @@ if __name__ == "__main__":
     try:
         main()
     except BaseException as e:  # noqa: BLE001 - must never leak a traceback
-        if isinstance(e, KeyboardInterrupt):
+        if isinstance(e, (KeyboardInterrupt, SystemExit)):
+            # intentional exits (argparse, sys.exit) keep their exit code
+            # instead of being re-labeled as workload errors
             raise
         err = f"{type(e).__name__}: {e}"
         if os.environ.get("BENCH_CPU_FALLBACK") != "1":

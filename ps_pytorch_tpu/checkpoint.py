@@ -163,13 +163,26 @@ def load_checkpoint(target, model_dir: str, step: int):
     AFTER the checkpoint was written, e.g. PSTrainState.comm_state) is
     filled with None instead of hard-erroring — old checkpoints stay
     resumable as long as the new feature is off. A non-None target field
-    still errors loudly (its state genuinely cannot be reconstructed)."""
+    still errors loudly (its state genuinely cannot be reconstructed).
+    The converse mismatch — the checkpoint CARRIES state for a feature
+    the target has off (stored comm_state, target None) — also errors
+    loudly: flax would otherwise pass the raw arrays through a None
+    target silently, and dropping accumulated EF residuals would quietly
+    change the training math."""
     raw = serialization.msgpack_restore(_read_bytes(model_dir, step))
     tgt_dict = serialization.to_state_dict(target)
     if isinstance(raw, dict) and isinstance(tgt_dict, dict):
         for k, v in tgt_dict.items():
             if k not in raw and v is None:
                 raw[k] = None
+            elif v is None and raw.get(k) is not None:
+                raise ValueError(
+                    f"checkpoint step {step} carries state for field {k!r} "
+                    f"but the target state has it disabled (None). Enable "
+                    f"the matching feature (e.g. --error-feedback for "
+                    f"comm_state) to resume this checkpoint, or rebuild it "
+                    f"without that state."
+                )
     return serialization.from_state_dict(target, raw)
 
 

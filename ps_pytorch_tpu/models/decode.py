@@ -131,9 +131,11 @@ def prefill(cfg: TransformerConfig, params: Dict, prompt: jax.Array,
     flash kernel with O(T) memory.
 
     Returns the updated cache (positions [0, T_prompt) filled). Cache
-    values are bit-identical to what T_prompt single-token decode steps
-    would have written — K/V depend only on each block's input
-    activations, which the batched causal forward reproduces exactly.
+    values are numerically equivalent (exact up to float reassociation)
+    to what T_prompt single-token decode steps would have written — K/V
+    depend only on each block's input activations, which the batched
+    causal forward reproduces, though XLA may fuse/reorder the batched
+    matmuls' reductions differently than the per-token path's.
     """
     from .transformer import select_attention, transformer_block
 

@@ -233,6 +233,28 @@ def test_pre_comm_state_checkpoints_still_resume(mesh, tmp_path):
     )
 
 
+def test_ef_checkpoint_into_non_ef_target_errors(mesh, tmp_path):
+    """The converse mismatch: a checkpoint CARRYING comm_state restored
+    into an error_feedback=False target (comm_state None) must raise — not
+    silently pass raw arrays through the None target (ADVICE r02)."""
+    from ps_pytorch_tpu.checkpoint import load_checkpoint, save_checkpoint
+
+    cfg_ef = PSConfig(num_workers=N, compress="int8", error_feedback=True)
+    state_ef = init_ps_state(
+        build_model("LeNet"), sgd(0.05), cfg_ef, jax.random.key(0),
+        (28, 28, 1),
+    )
+    save_checkpoint(state_ef, str(tmp_path), 3)
+
+    cfg_plain = PSConfig(num_workers=N)
+    target = init_ps_state(
+        build_model("LeNet"), sgd(0.05), cfg_plain, jax.random.key(0),
+        (28, 28, 1),
+    )
+    with pytest.raises(ValueError, match="comm_state|error-feedback"):
+        load_checkpoint(target, str(tmp_path), 3)
+
+
 def test_config_validation():
     with pytest.raises(ValueError, match="needs a compress"):
         PSConfig(num_workers=4, error_feedback=True)

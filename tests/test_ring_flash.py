@@ -182,6 +182,50 @@ def test_bidirectional_ring_flash_odd_n():
     )
 
 
+@pytest.mark.parametrize("causal", [False, True], ids=["bidir", "causal"])
+def test_ring_flash_odd_shard_len_pads_not_degrades(causal):
+    """Shard lengths that aren't block multiples (T=50 over a 5-ring ->
+    10-token shards) pad-and-mask inside flash_partial/flash_grads_partial
+    instead of silently shrinking tiles (code-review r03). Value AND
+    gradient must still match the oracle exactly."""
+    mesh5 = make_seq_mesh(5)
+    rng = np.random.RandomState(11)
+    mk = lambda: jnp.asarray(rng.randn(2, 50, 2, 16).astype(np.float32))
+    q, k, v = mk(), mk(), mk()
+
+    ring = make_ring_attention(mesh5, causal=causal, impl="flash")
+    got = ring(
+        shard_sequence(q, mesh5),
+        shard_sequence(k, mesh5),
+        shard_sequence(v, mesh5),
+    )
+    want = full_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(
+        jax.device_get(got), jax.device_get(want), rtol=2e-5, atol=2e-5
+    )
+
+    def ring_loss(q, k, v):
+        out = jax.shard_map(
+            lambda a, b, c: ring_flash_attention(a, b, c, SEQ_AXIS, causal),
+            mesh=mesh5,
+            in_specs=(P(None, SEQ_AXIS),) * 3,
+            out_specs=P(None, SEQ_AXIS),
+            check_vma=False,
+        )(q, k, v)
+        return jnp.sum(out * jnp.cos(out))
+
+    def full_loss(q, k, v):
+        out = full_attention(q, k, v, causal=causal)
+        return jnp.sum(out * jnp.cos(out))
+
+    got_g = jax.jit(jax.grad(ring_loss, argnums=(0, 1, 2)))(q, k, v)
+    want_g = jax.grad(full_loss, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got_g, want_g):
+        np.testing.assert_allclose(
+            jax.device_get(g), jax.device_get(w), rtol=5e-4, atol=5e-5
+        )
+
+
 def test_sp_transformer_flash_matches_single_device(seq_mesh):
     cfg = TransformerConfig(
         vocab_size=64, dim=64, depth=2, heads=4, max_seq_len=T,
