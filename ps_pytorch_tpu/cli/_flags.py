@@ -87,7 +87,8 @@ def add_ps_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--error-feedback", action="store_true",
                         help="EF-SGD: carry each worker's compression "
                              "residual into the next step (needs a "
-                             "--compress-grad mode; replicated placement)")
+                             "--compress-grad mode; works with both "
+                             "--opt-placement modes)")
     parser.add_argument("--quant-block-size", type=int, default=0,
                         help="per-block quantization scale granularity (0 = per-tensor)")
     parser.add_argument("--quant-rounding", type=str, default="nearest",

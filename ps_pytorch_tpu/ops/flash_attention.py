@@ -388,8 +388,8 @@ def _plan_blocks(t: int, want_q: int, want_k: int):
     (VERDICT r02 weak #3). Requested block sizes are floored to powers of
     two so the padded length is divisible by both (lcm = max) — a non-pow2
     request must never leave grid-uncovered tail rows."""
-    bq = min(_floor_pow2(want_q), max(8, _ceil_pow2(t)))
-    bk = min(_floor_pow2(want_k), max(8, _ceil_pow2(t)))
+    bq, _ = _plan_one(t, want_q)
+    bk, _ = _plan_one(t, want_k)
     lcm = max(bq, bk)  # both are powers of two: lcm = max
     tp = -(-t // lcm) * lcm
     return bq, bk, tp

@@ -105,6 +105,65 @@ def quantized_psum(
     )
 
 
+def _slice_len(total: int, n: int, block_size: int) -> int:
+    """Per-worker region length: ceil(total/n) rounded up to whole
+    quantization blocks."""
+    bs = block_size or 1
+    return (-(-total // n) + bs - 1) // bs * bs
+
+
+def _q2r_scatter_stage(g32, axis_name, n, s, block_size, rounding, leaf_key):
+    """Round 1 of the 2-round scheme for one flat padded [n*s] leaf:
+    shared-scale int8 quantize -> all_to_all int8 -> local int32 sum ->
+    dequantize MY region. Returns the f32 partial sum [s] — an int8-wire
+    reduce_scatter."""
+    q1, scale1 = quantize_int8(
+        g32,
+        axis_name=axis_name,  # shared (pmax) scales: replicated rows
+        block_size=block_size,
+        rounding=rounding,
+        key=leaf_key,
+    )
+    q1 = q1.reshape(n, s).astype(jnp.int8)
+    # row j of the a2a result = device j's slice of MY region
+    recv = lax.all_to_all(q1, axis_name, split_axis=0, concat_axis=0,
+                          tiled=True)
+    partial = jnp.sum(recv.astype(jnp.int32), axis=0)  # [s]
+    w = lax.axis_index(axis_name)
+    if block_size:
+        nb_loc = s // block_size
+        my_scales = lax.dynamic_slice(scale1, (w * nb_loc, 0), (nb_loc, 1))
+        partial = (
+            partial.reshape(nb_loc, block_size).astype(jnp.float32)
+            * my_scales
+        ).reshape(-1)
+    else:
+        partial = partial.astype(jnp.float32) * scale1
+    return partial
+
+
+def _q2r_gather_stage(partial, axis_name, n, s, block_size, rounding, key2):
+    """Round 2: requantize the [s] partial sum with LOCAL scales (regions
+    are disjoint, so no cross-worker scale agreement is needed) and
+    all_gather int8 (+ tiny f32 scale rows) -> dequantized full [n*s]."""
+    q2, scale2 = quantize_int8(
+        partial, block_size=block_size, rounding=rounding, key=key2
+    )
+    q2 = q2.reshape(-1).astype(jnp.int8)
+    full = lax.all_gather(q2, axis_name, tiled=True)  # int8 on the wire
+    if block_size:
+        scales2 = lax.all_gather(scale2, axis_name, tiled=True)  # [nb,1]
+        deq = (
+            full.reshape(-1, block_size).astype(jnp.float32) * scales2
+        ).reshape(-1)
+    else:
+        scales2 = lax.all_gather(scale2.reshape(1), axis_name, tiled=True)
+        deq = (
+            full.reshape(n, s).astype(jnp.float32) * scales2[:, None]
+        ).reshape(-1)
+    return deq
+
+
 def quantized_allreduce_2round(
     tree,
     axis_name: str,
@@ -144,54 +203,92 @@ def quantized_allreduce_2round(
     def one(i, g):
         g32 = g.astype(jnp.float32).reshape(-1)
         total = g32.shape[0]
-        bs = block_size or 1
-        # per-worker slice: ceil(total/n), rounded up to whole quant blocks
-        s = (-(-total // n) + bs - 1) // bs * bs
+        s = _slice_len(total, n, block_size)
         g32 = jnp.pad(g32, (0, n * s - total))
         leaf_key = jax.random.fold_in(key, i) if key is not None else None
-        q1, scale1 = quantize_int8(
-            g32,
-            axis_name=axis_name,  # shared (pmax) scales: replicated rows
-            block_size=block_size,
-            rounding=rounding,
-            key=leaf_key,
+        partial = _q2r_scatter_stage(
+            g32, axis_name, n, s, block_size, rounding, leaf_key
         )
-        q1 = q1.reshape(n, s).astype(jnp.int8)
-        # row j of the a2a result = device j's slice of MY region
-        recv = lax.all_to_all(q1, axis_name, split_axis=0, concat_axis=0,
-                              tiled=True)
-        partial = jnp.sum(recv.astype(jnp.int32), axis=0)  # [s]
-        w = lax.axis_index(axis_name)
-        if block_size:
-            nb_loc = s // block_size
-            my_scales = lax.dynamic_slice(
-                scale1, (w * nb_loc, 0), (nb_loc, 1)
-            )
-            partial = (
-                partial.reshape(nb_loc, block_size).astype(jnp.float32)
-                * my_scales
-            ).reshape(-1)
-        else:
-            partial = partial.astype(jnp.float32) * scale1
-        # round 2: requantize the partial sum with LOCAL scales (regions
-        # are disjoint, so no cross-worker scale agreement is needed)
         k2 = jax.random.fold_in(leaf_key, 1) if leaf_key is not None else None
-        q2, scale2 = quantize_int8(
-            partial, block_size=block_size, rounding=rounding, key=k2
+        deq = _q2r_gather_stage(
+            partial, axis_name, n, s, block_size, rounding, k2
         )
-        q2 = q2.reshape(-1).astype(jnp.int8)
-        full = lax.all_gather(q2, axis_name, tiled=True)  # int8 on the wire
-        if block_size:
-            scales2 = lax.all_gather(scale2, axis_name, tiled=True)  # [nb,1]
-            deq = (
-                full.reshape(-1, block_size).astype(jnp.float32) * scales2
-            ).reshape(-1)
-        else:
-            scales2 = lax.all_gather(scale2.reshape(1), axis_name, tiled=True)
-            deq = (
-                full.reshape(n, s).astype(jnp.float32) * scales2[:, None]
-            ).reshape(-1)
         return (deq[:total] / denominator).reshape(g.shape)
+
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    return jax.tree_util.tree_unflatten(
+        treedef, [one(i, g) for i, g in enumerate(leaves)]
+    )
+
+
+def quantized_allreduce_2round_hier(
+    tree,
+    axis_names: tuple,
+    denominator: float,
+    axis_sizes: tuple,
+    block_size: int = 0,
+    rounding: str = "nearest",
+    key: Optional[jax.Array] = None,
+):
+    """Hierarchical (DCN x ICI) bandwidth-honest int8 all-reduce that
+    crosses DCN exactly ONCE per gradient element.
+
+    Naively composing two flat 2-round all-reduces would end the inner
+    (ICI) round with an all_gather, leaving every ICI column holding the
+    identical full host-sum — and then per_host redundant int8 copies of
+    the whole gradient would cross the DCN bottleneck. Instead, per leaf:
+
+      1. inner int8-wire reduce_scatter over ICI (round-1 stage only):
+         each chip ends with the f32 partial sum of ITS 1/per_host
+         region of the host total;
+      2. a full 2-round int8 all-reduce over the DCN axis on that region
+         alone — the ICI columns carry DISJOINT regions, so total DCN
+         traffic is ~1 int8 byte/element regardless of per_host;
+      3. one f32 all_gather over ICI reassembles the globally-summed
+         vector (ICI bandwidth is an order of magnitude above DCN; the
+         scheme spends bytes on the link that has them).
+
+    axis_names = (dcn_axis, ici_axis); axis_sizes = (hosts, per_host).
+    Round-1 quantization (the EF contribution transform) is shared-scale
+    over the ICI axis with the key pre-folded by DCN index — mirror it
+    with local_quantized_contribution(axis_names[1], key=dcn_folded_key).
+    """
+    dcn_axis, ici_axis = axis_names
+    hosts, per_host = axis_sizes
+    if rounding == "stochastic":
+        if key is None:
+            raise ValueError("stochastic rounding needs a key")
+        # decorrelate across hosts FIRST (same-ICI-index chips on
+        # different hosts must not draw identical noise), then per chip
+        key = jax.random.fold_in(key, lax.axis_index(dcn_axis))
+        key = jax.random.fold_in(key, lax.axis_index(ici_axis))
+
+    def one(i, g):
+        g32 = g.astype(jnp.float32).reshape(-1)
+        total = g32.shape[0]
+        s1 = _slice_len(total, per_host, block_size)
+        g32 = jnp.pad(g32, (0, per_host * s1 - total))
+        leaf_key = jax.random.fold_in(key, i) if key is not None else None
+        # 1. ICI reduce_scatter: my [s1] region of the host sum
+        partial = _q2r_scatter_stage(
+            g32, ici_axis, per_host, s1, block_size, rounding, leaf_key
+        )
+        # 2. full 2-round over DCN on the region only
+        s2 = _slice_len(s1, hosts, block_size)
+        partial = jnp.pad(partial, (0, hosts * s2 - s1))
+        k_dcn = (
+            jax.random.fold_in(leaf_key, 2) if leaf_key is not None else None
+        )
+        p2 = _q2r_scatter_stage(
+            partial, dcn_axis, hosts, s2, block_size, rounding, k_dcn
+        )
+        k2 = jax.random.fold_in(k_dcn, 1) if k_dcn is not None else None
+        region = _q2r_gather_stage(
+            p2, dcn_axis, hosts, s2, block_size, rounding, k2
+        )[:s1]
+        # 3. reassemble over ICI (f32; ICI is the cheap link)
+        full = lax.all_gather(region, ici_axis, tiled=True)
+        return (full[:total] / denominator).reshape(g.shape)
 
     leaves, treedef = jax.tree_util.tree_flatten(tree)
     return jax.tree_util.tree_unflatten(
@@ -248,6 +345,7 @@ def aggregate_gradients(
     quant_rounding: str = "nearest",
     quant_key: Optional[jax.Array] = None,
     return_contribution: bool = False,
+    axis_sizes: Optional[tuple] = None,
 ):
     """The full PS aggregation: mask -> (quantized) reduce -> / K.
 
@@ -255,11 +353,24 @@ def aggregate_gradients(
     transmitted (post-mask, post-quantization-round-trip) value — what
     error feedback subtracts from the pre-aggregation gradient to get the
     true on-wire residual. The masking and compress dispatch live HERE
-    only; the EF path in ps.py must not re-implement them."""
+    only; the EF path in ps.py must not re-implement them.
+
+    A TUPLE axis_name (hierarchical DCN x ICI data parallelism) with
+    compress="int8_2round" runs the HIERARCHICAL 2-round scheme:
+    bandwidth-honest int8 all-reduce over ICI within each host first
+    (denominator 1), then the same scheme across the DCN axis on the
+    host-local sums — every wire crossing, intra- and inter-host, carries
+    int8. Requires `axis_sizes` = (hosts, workers_per_host). The EF
+    contribution mirrors the INNER ring's round-1 transform (the DCN
+    round's requantization noise is bounded and not residual-tracked,
+    same caveat as round 2 of the flat scheme)."""
     k = (
         num_aggregate
         if (num_aggregate is not None and num_aggregate < num_workers)
         else num_workers
+    )
+    hier_2round = compress == "int8_2round" and isinstance(
+        axis_name, (tuple, list)
     )
     if k != num_workers:
         sel = aggregation_mask(axis_name, num_workers, num_aggregate, mask_key, mask_mode)
@@ -272,6 +383,22 @@ def aggregate_gradients(
             grads,
             axis_name,
             float(k),
+            block_size=quant_block_size,
+            rounding=quant_rounding,
+            key=quant_key,
+        )
+        contribution = None
+    elif hier_2round:
+        if axis_sizes is None:
+            raise ValueError(
+                "hierarchical int8_2round needs axis_sizes=(hosts, "
+                "workers_per_host)"
+            )
+        agg = quantized_allreduce_2round_hier(
+            grads,
+            tuple(axis_name),
+            float(k),
+            tuple(axis_sizes),
             block_size=quant_block_size,
             rounding=quant_rounding,
             key=quant_key,
@@ -293,11 +420,21 @@ def aggregate_gradients(
     if not return_contribution:
         return agg
     if contribution is None:  # quantized modes share the round-1 transform
+        contrib_key = quant_key
+        if hier_2round and quant_rounding == "stochastic" and quant_key is not None:
+            # mirror the hier function's own fold chain (DCN index first,
+            # then local_quantized_contribution's internal ICI fold) so
+            # the residual tracks the transmitted values exactly
+            contrib_key = jax.random.fold_in(
+                quant_key, lax.axis_index(axis_name[0])
+            )
         contribution = local_quantized_contribution(
             grads,
-            axis_name,
+            # hierarchical 2round quantizes round 1 with scales shared
+            # over the INNER (ICI) axis only
+            axis_name[1] if hier_2round else axis_name,
             block_size=quant_block_size,
             rounding=quant_rounding,
-            key=quant_key,
+            key=contrib_key,
         )
     return agg, contribution

@@ -81,11 +81,12 @@ class PSConfig:
     # compression dropped and adds it back next step, so quantization
     # error accumulates into the update instead of being lost — the
     # standard convergence fix for aggressive compression. Requires a
-    # compress mode; replicated opt_placement only (the ZeRO path
-    # quantizes flat shards; its residual plumbing is future work).
-    # With quant_rounding="stochastic" + "int8_2round" the residual is
-    # approximate (padding changes the noise draw); pair EF with
-    # "nearest" for the exact on-wire residual.
+    # compress mode. Works with both placements: replicated keeps
+    # per-leaf residuals; the ZeRO-1 sharded placement keeps the residual
+    # on the flat padded gradient vector (same wire transform, same
+    # accounting). With quant_rounding="stochastic" + "int8_2round" the
+    # residual is approximate (padding changes the noise draw); pair EF
+    # with "nearest" for the exact on-wire residual.
     error_feedback: bool = False
     opt_placement: str = "replicated"  # "replicated" | "sharded"
     bn_mode: str = "pmean"  # "local" | "pmean" | "synced"
@@ -123,23 +124,27 @@ class PSConfig:
             raise ValueError(f"bad compress {self.compress!r}")
         if self.quant_rounding not in ("nearest", "stochastic"):
             raise ValueError(f"bad quant_rounding {self.quant_rounding!r}")
-        if self.error_feedback:
-            if self.compress in (None, "none"):
-                raise ValueError("error_feedback needs a compress mode")
-            if self.opt_placement == "sharded":
-                raise ValueError(
-                    "error_feedback is implemented for the replicated "
-                    "placement (ZeRO residual plumbing is future work)"
-                )
-        if self.compress == "int8_2round" and self.opt_placement == "sharded":
-            raise ValueError(
-                "int8_2round applies to the replicated path; the sharded "
-                "placement already reduce-scatters (use compress='int8')"
+        if self.error_feedback and self.compress in (None, "none"):
+            raise ValueError("error_feedback needs a compress mode")
+        if (
+            self.compress == "int8_2round"
+            and self.opt_placement == "sharded"
+            and (
+                self.dcn_hosts > 1
+                or isinstance(self.axis_name, (tuple, list))
             )
-        if self.compress == "int8_2round" and self.dcn_hosts > 1:
+        ):
+            # design note, not a TODO: the sharded placement's gradient
+            # wire is a single reduce_scatter over the full axis tuple;
+            # an int8 all_to_all over a product of DCN x ICI axes has no
+            # hierarchical routing to exploit (each chip's region still
+            # crosses DCN once either way). Use compress="int8" (int32
+            # psum_scatter) for sharded+DCN.
             raise ValueError(
-                "int8_2round is a flat-axis scheme; across DCN use the "
-                "hierarchical quantized psum (compress='int8')"
+                "int8_2round x sharded x dcn_hosts>1 is unsupported: the "
+                "sharded wire is one reduce_scatter over the whole mesh, "
+                "so there is no hierarchical structure for the 2-round "
+                "scheme to exploit — use compress='int8' there"
             )
 
     @property
@@ -171,7 +176,7 @@ def _zero1_shard_size(total: int, cfg: PSConfig) -> int:
     with block-quantized int8 collectives the shard is rounded up so each
     scattered slice owns whole quantization-scale rows."""
     shard = -(-total // cfg.num_workers)
-    if cfg.compress == "int8" and cfg.quant_block_size:
+    if cfg.compress in ("int8", "int8_2round") and cfg.quant_block_size:
         b = cfg.quant_block_size
         shard = -(-shard // b) * b
     return shard
@@ -206,11 +211,22 @@ def init_ps_state(
         )
     comm_state = None
     if cfg.error_feedback:
-        # zero residual per worker per param leaf, worker-stacked
-        comm_state = tree_map(
-            lambda p: jnp.zeros((cfg.num_workers,) + jnp.shape(p), jnp.float32),
-            params,
-        )
+        if cfg.opt_placement == "sharded":
+            # the sharded wire transforms the FLAT padded gradient vector,
+            # so its residual lives there too: one [L] row per worker
+            total = _flat_padded_size(params)
+            flat_len = _zero1_shard_size(total, cfg) * cfg.num_workers
+            comm_state = jnp.zeros(
+                (cfg.num_workers, flat_len), jnp.float32
+            )
+        else:
+            # zero residual per worker per param leaf, worker-stacked
+            comm_state = tree_map(
+                lambda p: jnp.zeros(
+                    (cfg.num_workers,) + jnp.shape(p), jnp.float32
+                ),
+                params,
+            )
     return PSTrainState(
         step=jnp.zeros([], jnp.int32),
         params=params,
@@ -254,44 +270,78 @@ def shard_batch(batch, mesh: Mesh, cfg: PSConfig):
     return jax.device_put(batch, NamedSharding(mesh, P(cfg.axis_name)))
 
 
-def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key, quant_key=None):
-    """ZeRO-1 "sharded PS": mask -> (quantize) -> reduce_scatter -> per-shard
-    optax update -> all_gather the parameter delta."""
+def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key,
+                       quant_key=None, err=None):
+    """ZeRO-1 "sharded PS": (EF add-back) -> mask -> (quantize) ->
+    reduce_scatter -> per-shard optax update -> all_gather the parameter
+    delta. Two compressed wires:
+
+    - "int8": quantize, int32 psum_scatter — the sum is EXACT in int32
+      but the interconnect carries int32 (compute-side compression).
+    - "int8_2round": quantize, int8 all_to_all, local int32 sum — the
+      wire genuinely carries int8 (~4x cut). In the sharded placement the
+      reduce_scatter IS round 1 of the 2-round scheme and no second round
+      exists: each chip keeps only its own region, so nothing is
+      re-broadcast (parameters return via the f32 all_gather of updates,
+      the analogue of the reference master's weight bcast).
+
+    `err` (error feedback) is this worker's residual on the FLAT padded
+    gradient vector; returns (new_params, new_opt, new_err)."""
     axis, n = cfg.axis_name, cfg.num_workers
     k = cfg.effective_aggregate
-    if k != n:
-        sel = aggregation_mask(axis, n, cfg.num_aggregate, mask_key, cfg.mask_mode)
-        grads = tree_map(lambda g: g * sel.astype(g.dtype), grads)
     flat_g, unravel = ravel_pytree(grads)
     total = flat_g.shape[0]
     shard = _zero1_shard_size(total, cfg)
     flat_g = jnp.pad(flat_g.astype(jnp.float32), (0, shard * n - total))
-    if cfg.compress == "int8":
+    if err is not None:
+        flat_g = flat_g + err
+    if k != n:
+        sel = aggregation_mask(axis, n, cfg.num_aggregate, mask_key, cfg.mask_mode)
+        sent = flat_g * sel
+    else:
+        sent = flat_g
+    new_err = None
+    bsz = cfg.quant_block_size
+    if cfg.compress in ("int8", "int8_2round"):
         if cfg.quant_rounding == "stochastic" and quant_key is not None:
             quant_key = jax.random.fold_in(quant_key, lax.axis_index(axis))
         q, scale = quantize_int8(
-            flat_g,
+            sent,
             axis_name=axis,
-            block_size=cfg.quant_block_size,
+            block_size=bsz,
             rounding=cfg.quant_rounding,
             key=quant_key,
         )
-        if cfg.quant_block_size:
-            # per-block scales: scatter blocks, keep scale rows aligned
-            qflat = q.reshape(-1)
-            s = lax.psum_scatter(qflat.astype(jnp.int32), axis, tiled=True)
-            nb_shard = s.shape[0] // cfg.quant_block_size
-            w = lax.axis_index(axis)
+        if err is not None:
+            # what the wire carries after the int8 round trip — the
+            # residual is everything it dropped (incl. the whole gradient
+            # on mask-excluded steps: sent==0 -> q==0 -> contribution 0)
+            contribution = dequantize_int8(
+                q.astype(jnp.int32), scale, block_size=bsz,
+                shape=(shard * n,),
+            )
+            new_err = flat_g - contribution
+        w = lax.axis_index(axis)
+        if cfg.compress == "int8":
+            s = lax.psum_scatter(
+                q.reshape(-1).astype(jnp.int32), axis, tiled=True
+            )
+        else:
+            q8 = q.reshape(n, shard).astype(jnp.int8)
+            recv = lax.all_to_all(
+                q8, axis, split_axis=0, concat_axis=0, tiled=True
+            )
+            s = jnp.sum(recv.astype(jnp.int32), axis=0)  # [shard]
+        if bsz:
+            nb_shard = shard // bsz
             scale_shard = lax.dynamic_slice(scale, (w * nb_shard, 0), (nb_shard, 1))
             g_shard = (
-                s.reshape(nb_shard, cfg.quant_block_size).astype(jnp.float32)
-                * scale_shard
+                s.reshape(nb_shard, bsz).astype(jnp.float32) * scale_shard
             ).reshape(-1) / k
         else:
-            s = lax.psum_scatter(q.astype(jnp.int32), axis, tiled=True)
             g_shard = dequantize_int8(s, scale) / k
     else:
-        g_shard = lax.psum_scatter(flat_g, axis, tiled=True) / k
+        g_shard = lax.psum_scatter(sent, axis, tiled=True) / k
     flat_p, _ = ravel_pytree(params)
     flat_p = jnp.pad(flat_p.astype(jnp.float32), (0, shard * n - total))
     w = lax.axis_index(axis)
@@ -299,7 +349,7 @@ def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key, quant_key=No
     upd_shard, new_opt = tx.update(g_shard, opt_state, p_shard)
     upd_full = lax.all_gather(upd_shard, axis, tiled=True)[:total]
     new_params = optax.apply_updates(params, unravel(upd_full))
-    return new_params, new_opt
+    return new_params, new_opt, new_err
 
 
 def make_ps_train_step(
@@ -319,6 +369,12 @@ def make_ps_train_step(
     """
     axis, n = cfg.axis_name, cfg.num_workers
     specs = state_specs(cfg)
+    # per-axis sizes for the hierarchical (DCN x ICI) 2-round scheme
+    hier_sizes = (
+        tuple(mesh.shape[a] for a in axis)
+        if isinstance(axis, (tuple, list))
+        else None
+    )
 
     def worker_fn(step_idx, params, opt_state, batch_stats, comm_state,
                   images, labels, key):
@@ -385,11 +441,14 @@ def make_ps_train_step(
             jax.random.fold_in(k_step, 0x5E) if cfg.compress else None
         )
         if cfg.opt_placement == "sharded":
-            params, new_opt = _sharded_ps_update(
+            err = comm_state[0] if cfg.error_feedback else None
+            params, new_opt, new_err = _sharded_ps_update(
                 params, opt_state, grads, tx, cfg, k_mask,
-                quant_key=quant_key,
+                quant_key=quant_key, err=err,
             )
             new_opt = tree_map(lambda a: a[None], new_opt)
+            if cfg.error_feedback:
+                new_comm = new_err[None]
         else:
             if cfg.error_feedback:
                 # EF-SGD: add back last step's compression residual before
@@ -411,6 +470,7 @@ def make_ps_train_step(
                 quant_rounding=cfg.quant_rounding,
                 quant_key=quant_key,
                 return_contribution=cfg.error_feedback,
+                axis_sizes=hier_sizes,
             )
             if cfg.error_feedback:
                 agg, contribution = out

@@ -105,6 +105,45 @@ def test_2round_awkward_sizes(mesh):
 
 
 @pytest.mark.parametrize("block", [0, 128], ids=["per_tensor", "per_block"])
+@pytest.mark.parametrize("rounding", ["nearest", "stochastic"])
+def test_hier_2round_close_to_exact_mean(block, rounding):
+    """quantized_allreduce_2round_hier over a 2x4 hybrid mesh: single-DCN-
+    crossing scheme stays within quantization error of the exact mean and
+    agrees on every chip (out_specs P() would fail otherwise)."""
+    from ps_pytorch_tpu.parallel import make_hybrid_mesh
+    from ps_pytorch_tpu.parallel.collectives import (
+        quantized_allreduce_2round_hier,
+    )
+
+    hmesh = make_hybrid_mesh(num_hosts=2, per_host=4)
+    tree = _tree(4, shapes=((57, 5), (301,)))
+    key = jax.random.key(0)
+
+    def body(t):
+        d = jax.lax.axis_index("dcn").astype(jnp.float32)
+        w = jax.lax.axis_index("workers").astype(jnp.float32)
+        local = jax.tree.map(lambda g: g * (1.0 + 0.05 * (4 * d + w)), t)
+        got = quantized_allreduce_2round_hier(
+            local, ("dcn", "workers"), float(N), (2, 4),
+            block_size=block, rounding=rounding,
+            key=key if rounding == "stochastic" else None,
+        )
+        want = psum_mean(local, ("dcn", "workers"), float(N))
+        return got, want
+
+    got, want = jax.jit(
+        jax.shard_map(
+            body, mesh=hmesh, in_specs=(P(),), out_specs=P(),
+            check_vma=False,
+        )
+    )(tree)
+    for g, w, orig in zip(got, want, tree):
+        bound = 3.0 * float(jnp.max(jnp.abs(orig))) * 1.5 / 127.0
+        err = float(jnp.max(jnp.abs(g - w)))
+        assert err <= bound, (err, bound)
+
+
+@pytest.mark.parametrize("block", [0, 128], ids=["per_tensor", "per_block"])
 def test_contribution_accounting_identity(mesh, block):
     """psum of per-worker transmitted values == k * quantized_psum result
     (denominator k) — bit-exact, so EF residuals are the true wire error."""
@@ -233,6 +272,116 @@ def test_pre_comm_state_checkpoints_still_resume(mesh, tmp_path):
     )
 
 
+@pytest.mark.parametrize("block", [0, 128], ids=["per_tensor", "per_block"])
+def test_sharded_2round_wire_matches_int8_scatter_bitwise(mesh, block):
+    """In the ZeRO-1 placement, the int8 all_to_all + local int32 sum
+    ("int8_2round": genuinely-int8 wire) must produce BIT-IDENTICAL
+    training math to the int32 psum_scatter ("int8"): both sum the same
+    int8 payloads exactly — only the bytes on the interconnect differ."""
+    results = {}
+    for compress in ("int8", "int8_2round"):
+        cfg = PSConfig(
+            num_workers=N, opt_placement="sharded", compress=compress,
+            quant_block_size=block,
+        )
+        state, step, batch = _tiny_setup(mesh, cfg, seed=5)
+        for i in range(3):
+            state, m = step(state, batch, jax.random.key(i))
+        results[compress] = (
+            jax.device_get(state.params), float(m["loss"])
+        )
+    assert results["int8"][1] == results["int8_2round"][1]
+    for a, b in zip(
+        jax.tree_util.tree_leaves(results["int8"][0]),
+        jax.tree_util.tree_leaves(results["int8_2round"][0]),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+@pytest.mark.parametrize("compress", ["int8", "int8_2round"])
+def test_sharded_error_feedback_trains_and_carries_residuals(mesh, compress):
+    """EF in the ZeRO-1 placement: residuals live on the flat padded
+    gradient vector, one [L] row per worker, and training converges."""
+    cfg = PSConfig(
+        num_workers=N, opt_placement="sharded", compress=compress,
+        quant_block_size=128, error_feedback=True,
+    )
+    state, step, batch = _tiny_setup(mesh, cfg, seed=2)
+    assert state.comm_state is not None and state.comm_state.ndim == 2
+    assert state.comm_state.shape[0] == N
+    losses = []
+    for i in range(6):
+        state, metrics = step(state, batch, jax.random.key(i))
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+    assert float(jnp.max(jnp.abs(state.comm_state))) > 0
+
+
+def test_sharded_ef_masked_workers_accumulate_full_gradient(mesh):
+    """first_k masking + sharded EF: excluded workers transmit zeros, so
+    their flat residual must dominate the transmitting workers'."""
+    cfg = PSConfig(
+        num_workers=N, opt_placement="sharded", compress="int8",
+        num_aggregate=2, mask_mode="first_k", error_feedback=True,
+    )
+    state, step, batch = _tiny_setup(mesh, cfg, seed=7)
+    state, _ = step(state, batch, jax.random.key(0))
+    res = np.asarray(jax.device_get(state.comm_state))  # [N, L]
+    excluded = np.abs(res[2:]).max()
+    included = np.abs(res[:2]).max()
+    assert excluded > included, (excluded, included)
+
+
+def test_hierarchical_2round_over_dcn(mesh):
+    """compress='int8_2round' with dcn_hosts=2: the hierarchical scheme
+    (ICI 2-round inside each host, then DCN 2-round on host sums) stays
+    within quantization error of the exact mean and trains."""
+    from ps_pytorch_tpu.parallel import make_hybrid_mesh
+
+    hmesh = make_hybrid_mesh(num_hosts=2, per_host=4)
+    cfg = PSConfig(num_workers=N, dcn_hosts=2, compress="int8_2round",
+                   quant_block_size=128)
+    state, step, batch = _tiny_setup(hmesh, cfg, seed=3)
+    losses = []
+    for i in range(6):
+        state, metrics = step(state, batch, jax.random.key(i))
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+    # one-step update close to the uncompressed hybrid run
+    cfg_ref = PSConfig(num_workers=N, dcn_hosts=2)
+    s_ref, step_ref, batch_ref = _tiny_setup(hmesh, cfg_ref, seed=3)
+    s_q, step_q, batch_q = _tiny_setup(hmesh, cfg, seed=3)
+    s_ref, _ = step_ref(s_ref, batch_ref, jax.random.key(0))
+    s_q, _ = step_q(s_q, batch_q, jax.random.key(0))
+    for a, b in zip(
+        jax.tree_util.tree_leaves(jax.device_get(s_ref.params)),
+        jax.tree_util.tree_leaves(jax.device_get(s_q.params)),
+    ):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0.1, atol=5e-3
+        )
+
+
+def test_hierarchical_2round_ef_trains(mesh):
+    """EF on top of the hierarchical DCN scheme (residual mirrors the
+    inner ICI ring's round-1 transform)."""
+    from ps_pytorch_tpu.parallel import make_hybrid_mesh
+
+    hmesh = make_hybrid_mesh(num_hosts=2, per_host=4)
+    cfg = PSConfig(num_workers=N, dcn_hosts=2, compress="int8_2round",
+                   quant_block_size=128, error_feedback=True)
+    state, step, batch = _tiny_setup(hmesh, cfg, seed=3)
+    losses = []
+    for i in range(6):
+        state, metrics = step(state, batch, jax.random.key(i))
+        losses.append(float(metrics["loss"]))
+    assert all(np.isfinite(losses)), losses
+    assert losses[-1] < losses[0], losses
+
+
 def test_ef_checkpoint_into_non_ef_target_errors(mesh, tmp_path):
     """The converse mismatch: a checkpoint CARRYING comm_state restored
     into an error_feedback=False target (comm_state None) must raise — not
@@ -258,8 +407,16 @@ def test_ef_checkpoint_into_non_ef_target_errors(mesh, tmp_path):
 def test_config_validation():
     with pytest.raises(ValueError, match="needs a compress"):
         PSConfig(num_workers=4, error_feedback=True)
-    with pytest.raises(ValueError, match="replicated"):
-        PSConfig(num_workers=4, compress="int8", error_feedback=True,
-                 opt_placement="sharded")
-    with pytest.raises(ValueError, match="replicated|sharded"):
-        PSConfig(num_workers=4, compress="int8_2round", opt_placement="sharded")
+    # r03: EF x sharded and 2round x sharded are now SUPPORTED; the one
+    # remaining fence is the 3-way combo whose wire has no hierarchy to
+    # exploit (see PSConfig.__post_init__'s design note)
+    PSConfig(num_workers=4, compress="int8", error_feedback=True,
+             opt_placement="sharded")
+    PSConfig(num_workers=4, compress="int8_2round", opt_placement="sharded")
+    with pytest.raises(ValueError, match="unsupported"):
+        PSConfig(num_workers=8, compress="int8_2round",
+                 opt_placement="sharded", dcn_hosts=2)
+    # the explicit-tuple form must hit the same fence (review r03)
+    with pytest.raises(ValueError, match="unsupported"):
+        PSConfig(num_workers=8, compress="int8_2round",
+                 opt_placement="sharded", axis_name=("dcn", "workers"))
