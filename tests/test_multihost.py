@@ -18,7 +18,6 @@ devices each -> one 8-device job) and drives the actual product CLI:
 import json
 import os
 import signal
-import socket
 import subprocess
 import sys
 import time
@@ -29,12 +28,7 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
 from tpu_env import clean_cpu_env  # noqa: E402
-
-
-def _free_port() -> int:
-    with socket.socket() as s:
-        s.bind(("localhost", 0))
-        return s.getsockname()[1]
+from tools.mp_util import free_port as _free_port  # noqa: E402
 
 
 def _spawn(pid: int, port: int, tmp, extra):

@@ -1,0 +1,162 @@
+"""Multi-PROCESS (DCN) scaling sweep through the real product CLI.
+
+analysis/scaling_bench.py scales mesh size inside one process (ICI-shaped
+scaling). This tool scales the number of real `jax.distributed` PROCESSES
+— the DCN axis — exactly the way tools/run_multihost.sh launches a pod:
+K processes x D virtual CPU devices each, all running
+
+    python -m ps_pytorch_tpu.cli.train --dcn-hosts K --num-workers K*D \
+        --coordinator-address localhost:PORT --num-processes K ...
+
+and reports weak-scaling throughput from the per-step time_cost in the
+metrics JSONL (median of post-warmup steps, so one-off compile time is
+excluded).
+
+On a single machine every process contends for the same cores, so the
+numbers measure harness shape, not interconnect (the JSON records
+platform="cpu" and contention=true — nobody should mistake this for an
+ICI/DCN curve; the reference's EC2 numbers in BASELINE.md are the real
+comparison target once hardware exists). What it DOES prove: the full
+multi-process rendezvous + hybrid-mesh + collective-checkpoint path works
+at each K through the product CLI, and per-step cost is flat in K modulo
+contention.
+
+  python tools/dcn_scaling.py --hosts 1 2 4 --per-host-devices 4 \
+      --steps 20 --json runs/scaling_dcn_virtual.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpu_env import clean_cpu_env  # noqa: E402
+from tools.mp_util import free_port, wait_all  # noqa: E402
+
+
+def _spawn(pid, port, n_procs, n_dev, tmp, args, out_file):
+    env = clean_cpu_env(n_devices=n_dev)
+    argv = [
+        sys.executable, "-m", "ps_pytorch_tpu.cli.train",
+        "--network", args.network, "--dataset", args.dataset,
+        "--batch-size", str(args.per_worker_batch * n_procs * n_dev),
+        "--num-workers", str(n_procs * n_dev),
+        "--max-steps", str(args.steps),
+        "--log-interval", "1",  # metrics rows follow log-interval; the
+                                # sweep needs every step's time_cost
+        "--eval-freq", "0", "--no-checkpoints",
+        "--metrics-file", os.path.join(tmp, f"metrics_{pid}.jsonl"),
+        "--train-dir", os.path.join(tmp, "ckpt"),
+    ]
+    if n_procs > 1:
+        argv += [
+            "--coordinator-address", f"localhost:{port}",
+            "--num-processes", str(n_procs),
+            "--process-id", str(pid),
+            "--dcn-hosts", str(n_procs),
+        ]
+    if args.compress:
+        argv += ["--compress-grad", "compress"]
+    # output to a FILE, not a pipe: a blocked stdout writer would stall
+    # the whole collective group (see tools/mp_util.py)
+    return subprocess.Popen(
+        argv, env=env, cwd=REPO,
+        stdout=out_file, stderr=subprocess.STDOUT,
+    )
+
+
+def bench_hosts(n_procs, args):
+    port = free_port()
+    n_dev = args.per_host_devices
+    with tempfile.TemporaryDirectory() as tmp:
+        logs = [os.path.join(tmp, f"out_{i}.log") for i in range(n_procs)]
+        files = [open(l, "w") for l in logs]
+        try:
+            procs = [
+                _spawn(i, port, n_procs, n_dev, tmp, args, files[i])
+                for i in range(n_procs)
+            ]
+
+            def log_tail(i):
+                files[i].flush()
+                with open(logs[i]) as f:
+                    return f.read()
+
+            wait_all(procs, args.timeout, log_tail=log_tail)
+        finally:
+            for f in files:
+                f.close()
+        with open(os.path.join(tmp, "metrics_0.jsonl")) as f:
+            costs = [
+                json.loads(l)["time_cost"] for l in f if '"train"' in l
+            ]
+    if not costs:
+        raise RuntimeError(f"hosts={n_procs}: no train metrics recorded")
+    # drop the compile-dominated warmup steps, take the median of the rest
+    skip = min(max(2, len(costs) // 4), len(costs) - 1)
+    steady = sorted(costs[skip:])
+    med = steady[len(steady) // 2]
+    global_batch = args.per_worker_batch * n_procs * n_dev
+    return {
+        "hosts": n_procs,
+        "devices_per_host": n_dev,
+        "workers": n_procs * n_dev,
+        "global_batch": global_batch,
+        "median_step_s": round(med, 6),
+        "images_per_sec": round(global_batch / med, 1),
+        "steps_timed": len(steady),
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser("tools.dcn_scaling")
+    p.add_argument("--hosts", type=int, nargs="+", default=[1, 2, 4])
+    p.add_argument("--per-host-devices", type=int, default=4)
+    p.add_argument("--per-worker-batch", type=int, default=64)
+    p.add_argument("--network", default="LeNet")
+    p.add_argument("--dataset", default="MNIST")
+    p.add_argument("--steps", type=int, default=20)
+    p.add_argument("--compress", action="store_true")
+    p.add_argument("--timeout", type=int, default=900)
+    p.add_argument("--json", default=None)
+    args = p.parse_args(argv)
+
+    rows = []
+    for k in args.hosts:
+        rows.append(bench_hosts(k, args))
+        print(rows[-1], flush=True)
+    base = rows[0]
+    for r in rows:
+        thr = r["images_per_sec"] / base["images_per_sec"]
+        r["speedup_vs_first"] = round(thr, 3)
+        r["scaling_efficiency"] = round(thr / (r["hosts"] / base["hosts"]), 3)
+    result = {
+        "platform": "cpu",
+        "contention": True,
+        "note": (
+            "real jax.distributed processes on ONE machine — proves the "
+            "multi-process DCN path end to end; throughput shape only "
+            "(processes contend for the same cores, so efficiency is NOT "
+            "an interconnect measurement)"
+        ),
+        "network": args.network,
+        "mode": "weak",
+        "per_worker_batch": args.per_worker_batch,
+        "rows": rows,
+    }
+    print(json.dumps(result))
+    if args.json:
+        with open(args.json, "w") as f:
+            json.dump(result, f, indent=2)
+    return result
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,63 @@
+"""Shared helpers for launching coupled multi-process `jax.distributed`
+jobs on one machine (used by tools/dcn_scaling.py and
+tests/test_multihost.py).
+
+Two output-capture patterns exist on purpose:
+- tests capture stdout via PIPE + communicate() because they assert on
+  the text (their runs emit a few KB, far below the pipe buffer);
+- the scaling tool redirects each child to a FILE, because a long sweep
+  with --log-interval 1 can exceed the 64KB pipe buffer and a blocked
+  writer stalls the whole collective (every process waits on the slowest).
+"""
+
+from __future__ import annotations
+
+import socket
+import subprocess
+import time
+
+
+def free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("localhost", 0))
+        return s.getsockname()[1]
+
+
+def kill_all(procs) -> None:
+    for p in procs:
+        if p.poll() is None:
+            p.kill()
+    for p in procs:
+        try:
+            p.wait(timeout=10)
+        except subprocess.TimeoutExpired:
+            pass
+
+
+def wait_all(procs, timeout: float, log_tail=None) -> None:
+    """Wait for every process under ONE shared deadline. On timeout or a
+    nonzero exit, kill the whole group first (coupled jax.distributed
+    processes block each other's collectives — an orphaned hang pins
+    cores and the coordinator port), then raise with whatever `log_tail`
+    (pid -> str) can recover."""
+    deadline = time.monotonic() + timeout
+
+    def tail(i):
+        return log_tail(i) if log_tail else ""
+
+    for i, p in enumerate(procs):
+        try:
+            p.wait(timeout=max(5.0, deadline - time.monotonic()))
+        except subprocess.TimeoutExpired:
+            kill_all(procs)
+            raise RuntimeError(
+                f"process {i} exceeded the shared {timeout}s deadline; "
+                f"group killed.\n{tail(i)[-3000:]}"
+            ) from None
+    bad = [(i, p.returncode) for i, p in enumerate(procs) if p.returncode]
+    if bad:
+        kill_all(procs)  # no-op for exited procs; safety for stragglers
+        i, rc = bad[0]
+        raise RuntimeError(
+            f"process {i} exited rc={rc}.\n{tail(i)[-3000:]}"
+        )

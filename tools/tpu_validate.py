@@ -177,7 +177,9 @@ def bench_ring_flash(quick):
 def main(argv=None):
     p = argparse.ArgumentParser(__doc__)
     p.add_argument("--seq-lens", type=int, nargs="+",
-                   default=[1024, 2048, 4096, 8192])
+                   default=[1000, 1024, 2048, 4096, 8192])
+    # T=1000 exercises the pad-and-mask path (odd length -> 1024 grid with
+    # masked tail) COMPILED — fresh r03 kernel-side code
     p.add_argument("--dtypes", nargs="+", default=["float32", "bfloat16"])
     p.add_argument("--quick", action="store_true")
     p.add_argument("--out", default=os.path.join(REPO, "runs", "tpu_validate.json"))
