@@ -7,6 +7,24 @@ kernels COMPILED on the attached accelerator, checks parity against the
 jnp oracles, times them against the naive implementations, and emits one
 JSON report (tools/../runs/tpu_validate.json by default).
 
+Two lessons from the first live-hardware window (runs/tpu_r03/NOTES.md)
+are baked in:
+
+* **Precision-aware parity.** On the MXU, f32 matmuls multiply in bf16 at
+  DEFAULT precision — both in the Pallas kernel and in the jnp oracle, with
+  different reduction orders, so flash-vs-naive disagreement at default
+  precision is ~3e-3 and means nothing. The oracle here runs under
+  `jax.default_matmul_precision("highest")`; the kernel is additionally
+  re-traced under the same context, and if the lowered kernel actually
+  achieves tight (<2e-4) agreement we gate on that ("highest" parity mode).
+  If Mosaic ignores/rejects the precision request, the gate falls back to a
+  default-precision bound derived from bf16 multiply rounding.
+* **Chained timing.** Per-call dispatch through the axon tunnel costs
+  ~24 ms — far more than any kernel here. All timings chain `reps`
+  data-dependent applications inside ONE jitted `lax.fori_loop`, so the
+  dispatch floor amortizes away and the per-iteration number measures the
+  kernel, not the tunnel.
+
 Run (real chip):    python tools/tpu_validate.py
 Smoke (CPU, interpret): PS_TPU_PALLAS_INTERPRET=1 JAX_PLATFORMS=cpu \
                         python tools/tpu_validate.py --seq-lens 256 --quick
@@ -23,20 +41,43 @@ import time
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 sys.path.insert(0, REPO)
 
+# flash@default vs oracle@highest, f32 inputs: bf16 multiply rounding
+# (2^-8 relative) accumulated in f32 over O(T) softmax terms of O(1)
+# magnitude. Observed 3.3e-3 at T=256 on v5e; 2e-2 leaves headroom for
+# T=8192 without masking a real indexing bug (those show up as O(1)).
+F32_DEFAULT_PRECISION_BOUND = 2e-2
+F32_TIGHT_BOUND = 2e-4          # exact-math paths: CPU, or MXU at "highest"
+BF16_BOUND = 0.1                # bf16 storage rounding dominates
 
-def _time(fn, *args, iters=20, warmup=3):
+
+def _chain_time(step, init, iters, reps):
+    """Best-of-`iters` per-application seconds of `step` chained `reps` times
+    inside one jitted fori_loop (amortizes per-dispatch tunnel latency; min is
+    the least-noise wall-time estimator)."""
     import jax
 
     from ps_pytorch_tpu.utils import host_sync
 
-    for _ in range(warmup):
-        out = fn(*args)
+    @jax.jit
+    def run(carry):
+        return jax.lax.fori_loop(0, reps, lambda i, c: step(c), carry)
+
+    out = run(init)  # compile + warm
     host_sync(out)
-    t0 = time.perf_counter()
+    times = []
     for _ in range(iters):
-        out = fn(*args)
-    host_sync(out)
-    return (time.perf_counter() - t0) / iters
+        t0 = time.perf_counter()
+        out = run(init)
+        host_sync(out)
+        times.append((time.perf_counter() - t0) / reps)
+    return min(times)
+
+
+def _normed(x):
+    import jax.numpy as jnp
+
+    # keep chained carries O(1) so timing loops can't drift to inf/denormal
+    return (x / (jnp.sqrt(jnp.mean(jnp.square(x.astype(jnp.float32)))) + 1e-6)).astype(x.dtype)
 
 
 def bench_flash(seq_lens, dtype_name, quick):
@@ -48,6 +89,7 @@ def bench_flash(seq_lens, dtype_name, quick):
     from ps_pytorch_tpu.parallel.ring_attention import full_attention
 
     dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
+    on_cpu = jax.default_backend() == "cpu"
     rows = []
     for t in seq_lens:
         b, h, d = (1, 4, 64) if t >= 4096 else (2, 8, 64)
@@ -58,11 +100,23 @@ def bench_flash(seq_lens, dtype_name, quick):
         flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
         naive = jax.jit(lambda q, k, v: full_attention(q, k, v, causal=True))
 
-        got = jax.device_get(flash(q, k, v)).astype(np.float32)
-        want = jax.device_get(naive(q, k, v)).astype(np.float32)
-        fwd_err = float(np.max(np.abs(got - want)))
+        # the precision config is read at TRACE time, so it must be entered
+        # inside the traced body — a `with` around jax.jit() construction
+        # (or around anything but the first call) is a silent no-op
+        def _hi(fn):
+            def wrapped(q, k, v):
+                with jax.default_matmul_precision("highest"):
+                    return fn(q, k, v, causal=True)
+            return jax.jit(wrapped)
 
-        # gradient parity through the custom VJP
+        oracle = _hi(full_attention)
+        flash_hi = _hi(flash_attention)
+
+        def _get(x):
+            return jax.device_get(x).astype(np.float32)
+
+        # gradient functions (flash: custom VJP; naive: autodiff of the
+        # highest-precision oracle)
         def loss_flash(q, k, v):
             o = flash_attention(q, k, v, causal=True)
             return jnp.sum(o.astype(jnp.float32) ** 2)
@@ -71,40 +125,126 @@ def bench_flash(seq_lens, dtype_name, quick):
             o = full_attention(q, k, v, causal=True)
             return jnp.sum(o.astype(jnp.float32) ** 2)
 
-        gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
-        gn = jax.jit(jax.grad(loss_naive, argnums=(0, 1, 2)))
-        bwd_err = max(
-            float(
-                np.max(
-                    np.abs(
-                        jax.device_get(a).astype(np.float32)
-                        - jax.device_get(b_).astype(np.float32)
-                    )
-                )
-            )
-            for a, b_ in zip(gf(q, k, v), gn(q, k, v))
-        )
+        def loss_naive_hi(q, k, v):
+            with jax.default_matmul_precision("highest"):
+                return loss_naive(q, k, v)
 
-        iters = 3 if quick else (10 if t >= 4096 else 20)
-        t_flash = _time(flash, q, k, v, iters=iters)
-        t_naive = _time(naive, q, k, v, iters=iters) if t <= 8192 else None
-        tg_flash = _time(lambda *a: gf(*a)[0], q, k, v, iters=iters)
-        tg_naive = _time(lambda *a: gn(*a)[0], q, k, v, iters=iters)
+        gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
+        gn = jax.jit(jax.grad(loss_naive_hi, argnums=(0, 1, 2)))
+        # timing comparator: DEFAULT-precision naive grad — gn's "highest"
+        # matmuls run multi-pass on the MXU and would inflate bwd_speedup
+        gn_time = jax.jit(jax.grad(loss_naive, argnums=(0, 1, 2)))
+
+        # every naive/oracle evaluation materializes the [B,H,T,T] scores
+        # tensor — beyond T=8192 that OOMs (17 GB at the LM bench shape,
+        # runs/tpu_r03/NOTES.md), so beyond it run flash alone and record the
+        # parity fields as untested rather than lose the whole report
+        use_naive = t <= 8192
+        highest_fail = None
+        if use_naive:
+            want = _get(oracle(q, k, v))
+            got = _get(flash(q, k, v))
+            fwd_err = float(np.max(np.abs(got - want)))
+            fwd_err_default_oracle = float(
+                np.max(np.abs(got - _get(naive(q, k, v))))
+            )
+            # does the Mosaic-lowered kernel honor the "highest" request?
+            # (it may also silently ignore it — _gate_checks handles that by
+            # bounding err_highest by the reduction-order noise floor)
+            try:
+                fwd_err_highest = float(
+                    np.max(np.abs(_get(flash_hi(q, k, v)) - want))
+                )
+            except Exception as e:  # lowering/infra failure — record which
+                fwd_err_highest = None
+                highest_fail = f"{type(e).__name__}: {str(e)[:300]}"
+                print(f"flash@highest failed: {highest_fail}", flush=True)
+            highest_ok = (
+                fwd_err_highest is not None
+                and fwd_err_highest < F32_TIGHT_BOUND
+            )
+            bwd_err = max(
+                float(np.max(np.abs(_get(a) - _get(b_))))
+                for a, b_ in zip(gf(q, k, v), gn(q, k, v))
+            )
+            parity_mode = "highest" if highest_ok else (
+                "exact" if on_cpu else "default"
+            )
+        else:
+            fwd_err = fwd_err_default_oracle = fwd_err_highest = None
+            bwd_err = None
+            parity_mode = "untested"
+
+        def _all3(grads):
+            # consume dq+dk+dv so XLA can't dead-code-eliminate the naive
+            # oracle's dk/dv branches while flash's opaque Pallas bwd kernel
+            # computes all three (q/k/v share one shape here)
+            dq, dk, dv = grads
+            return _normed(dq + dk + dv)
+
+        reps = 4 if quick else (8 if t >= 4096 else 16)
+        iters = 2 if quick else 5
+        t_flash = _chain_time(
+            lambda c: _normed(flash(c, k, v)), q, iters, reps
+        )
+        t_naive = (
+            _chain_time(lambda c: _normed(naive(c, k, v)), q, iters, reps)
+            if use_naive else None
+        )
+        tg_flash = _chain_time(
+            lambda c: _all3(gf(c, k, v)), q, iters, reps
+        )
+        tg_naive = (
+            _chain_time(lambda c: _all3(gn_time(c, k, v)), q, iters, reps)
+            if use_naive else None
+        )
         rows.append(
             {
                 "T": t, "B": b, "H": h, "D": d, "dtype": dtype_name,
                 "fwd_max_abs_err": fwd_err,
+                "fwd_err_default_oracle": fwd_err_default_oracle,
+                "fwd_max_abs_err_highest": fwd_err_highest,
+                "highest_fail": highest_fail,
+                "parity_mode": parity_mode,
                 "bwd_max_abs_err": bwd_err,
                 "fwd_ms_flash": round(t_flash * 1e3, 3),
-                "fwd_ms_naive": round(t_naive * 1e3, 3) if t_naive else None,
-                "fwd_speedup": round(t_naive / t_flash, 2) if t_naive else None,
+                "fwd_ms_naive": round(t_naive * 1e3, 3) if use_naive else None,
+                "fwd_speedup": round(t_naive / t_flash, 2) if use_naive else None,
                 "bwd_ms_flash": round(tg_flash * 1e3, 3),
-                "bwd_ms_naive": round(tg_naive * 1e3, 3),
-                "bwd_speedup": round(tg_naive / tg_flash, 2),
+                "bwd_ms_naive": round(tg_naive * 1e3, 3) if use_naive else None,
+                "bwd_speedup": round(tg_naive / tg_flash, 2) if use_naive else None,
+                "timing_reps": reps,
             }
         )
         print(f"flash T={t}: {rows[-1]}", flush=True)
     return rows
+
+
+def _gate_checks(row, on_cpu):
+    """(label, error, bound) assertions for a flash row. The default-precision
+    kernel — the path production uses — is ALWAYS gated. When the "highest"
+    retrace lowered successfully, its error is gated too: Mosaic may honor
+    the request (error should hit F32_TIGHT_BOUND) or silently ignore it
+    (error stays at the reduction-order noise floor, measured here by the
+    disagreement between the two default-precision implementations) — but it
+    must not exceed that floor, which is what a real kernel regression does."""
+    if row["parity_mode"] == "untested":  # T too large for the jnp oracle
+        return []
+    if row["dtype"] == "bfloat16":
+        return [("bf16", row["fwd_max_abs_err"], BF16_BOUND)]
+    if on_cpu:
+        return [("f32-exact", row["fwd_max_abs_err"], F32_TIGHT_BOUND)]
+    checks = [
+        ("f32-default", row["fwd_max_abs_err"], F32_DEFAULT_PRECISION_BOUND)
+    ]
+    if row["fwd_max_abs_err_highest"] is not None:
+        noise_floor = max(
+            F32_TIGHT_BOUND, 4.0 * row["fwd_err_default_oracle"]
+        )
+        checks.append(
+            ("f32-highest", row["fwd_max_abs_err_highest"], noise_floor)
+        )
+    return checks
 
 
 def bench_quantizers(quick):
@@ -133,14 +273,23 @@ def bench_quantizers(quick):
                 bound = float(jnp.max(jnp.abs(scale))) + 1e-7
             else:
                 bound = float(jnp.max(jnp.abs(x))) / 127.0 + 1e-7
-            t_enc = _time(lambda a: enc(a)[0], x, iters=3 if quick else 30)
+
+            def roundtrip(c):
+                qq, ss = enc(c)
+                return dec(qq, ss)
+
+            t_rt = _chain_time(
+                roundtrip, x, iters=2 if quick else 5,
+                reps=4 if quick else 16,
+            )
             rows.append(
                 {
                     "kernel": name, "n": n,
                     "max_abs_err": err, "err_bound": bound,
                     "within_bound": err <= bound * 1.01,
-                    "enc_ms": round(t_enc * 1e3, 3),
-                    "GBps": round(4 * n / t_enc / 1e9, 1),
+                    "roundtrip_ms": round(t_rt * 1e3, 3),
+                    # f32 in + f32 out of the enc+dec pair
+                    "GBps_roundtrip": round(8 * n / t_rt / 1e9, 1),
                 }
             )
             print(f"quant {name} n={n}: {rows[-1]}", flush=True)
@@ -160,6 +309,7 @@ def bench_ring_flash(quick):
         make_seq_mesh,
     )
 
+    on_cpu = jax.default_backend() == "cpu"
     mesh = make_seq_mesh(len(jax.devices()))
     t = 512 if quick else 2048
     rng = np.random.RandomState(7)
@@ -167,9 +317,18 @@ def bench_ring_flash(quick):
     q, k, v = mk(), mk(), mk()
     ring = make_ring_attention(mesh, causal=True, impl="flash")
     got = jax.device_get(ring(q, k, v))
-    want = jax.device_get(full_attention(q, k, v, causal=True))
+    with jax.default_matmul_precision("highest"):
+        want = jax.device_get(
+            jax.jit(lambda q, k, v: full_attention(q, k, v, causal=True))(
+                q, k, v
+            )
+        )
     err = float(np.max(np.abs(got - want)))
-    row = {"T": t, "devices": len(jax.devices()), "max_abs_err": err}
+    bound = F32_TIGHT_BOUND if on_cpu else F32_DEFAULT_PRECISION_BOUND
+    row = {
+        "T": t, "devices": len(jax.devices()),
+        "max_abs_err": err, "bound": bound, "ok": err < bound,
+    }
     print(f"ring-flash: {row}", flush=True)
     return [row]
 
@@ -191,6 +350,7 @@ def main(argv=None):
 
     enable_persistent_compile_cache()
     dev = jax.devices()[0]
+    on_cpu = jax.default_backend() == "cpu"
     report = {
         "platform": dev.platform,
         "device_kind": getattr(dev, "device_kind", "?"),
@@ -205,13 +365,14 @@ def main(argv=None):
     report["quantizers"] = bench_quantizers(args.quick)
 
     # hard gates: parity must hold compiled, not just interpret
-    worst_f32 = max(
-        (r["fwd_max_abs_err"] for r in report["flash"] if r["dtype"] == "float32"),
-        default=0.0,
-    )
-    assert worst_f32 < 2e-4, f"compiled flash f32 parity broken: {worst_f32}"
+    failures = []
+    for r in report["flash"]:
+        for label, err, bound in _gate_checks(r, on_cpu):
+            if err >= bound:
+                failures.append((r["T"], r["dtype"], label, err, bound))
+    assert not failures, f"compiled flash fwd parity broken: {failures}"
     assert all(q["within_bound"] for q in report["quantizers"])
-    assert all(r["max_abs_err"] < 2e-4 for r in report["ring_flash"])
+    assert all(r["ok"] for r in report["ring_flash"])
 
     os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as f:
