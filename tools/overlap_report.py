@@ -1,0 +1,369 @@
+"""Comm/compute-overlap evidence for the PS engine (SURVEY component #12).
+
+The reference hand-pipelines per-layer gradient sends so communication of
+layer k+1's gradient overlaps backprop of layer k
+(/root/reference/src/model_ops/resnet_split.py:262-363). The TPU re-design
+deletes that machinery and relies on XLA: the gradient psum lowers to async
+`all-reduce-start`/`all-reduce-done` pairs and the latency-hiding scheduler
+places backward compute between them. This tool produces the evidence, three
+ways (most → least direct):
+
+  trace     parse a `--profile-dir` Chrome trace (trace.json.gz) from a real
+            run and measure wall-clock overlap between collective and compute
+            events on the device timeline. Needs a device that emits an
+            op-level timeline (TPU; the CPU backend logs host events only).
+  topology  AOT-compile the SPMD train step for an N-chip TPU topology via
+            `jax.experimental.topologies` (no chips needed — the compiler
+            does the scheduling) and analyze the compiled schedule.
+  hlo       compile for the attached backend (e.g. the 8-device virtual CPU
+            mesh) and analyze the compiled schedule. NOTE the CPU backend
+            combines the whole gradient tree into ONE synchronous all-reduce
+            scheduled after backward — a property of XLA:CPU, not of the
+            engine; this mode exists to exercise the analyzer and to show
+            the HLO the partitioner emits.
+
+Schedule analysis: in a scheduled HLO module the textual instruction order
+of the entry computation IS the execution order. For every async collective
+pair we count the compute instructions (fusion/convolution/dot/...) placed
+between -start and -done: >0 means the scheduler hid (part of) the
+collective behind compute. Sync collectives are reported with their position
+in the schedule instead.
+
+Usage:
+  python tools/overlap_report.py hlo --workers 8 --network ResNet18
+  python tools/overlap_report.py trace --profile-dir runs/profile/...
+  python tools/overlap_report.py topology --topology v5e:2x4 --workers 8
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import gzip
+import json
+import os
+import re
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+COLLECTIVE_OPS = (
+    "all-reduce-start", "all-reduce-done", "all-reduce",
+    "all-gather-start", "all-gather-done", "all-gather",
+    "reduce-scatter", "collective-permute-start",
+    "collective-permute-done", "collective-permute", "all-to-all",
+)
+COMPUTE_OPS = (
+    "fusion", "convolution", "dot", "reduce", "scatter", "select-and-scatter",
+    "custom-call", "sort", "cholesky", "triangular-solve",
+)
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "bf16": 2, "f16": 2, "s64": 8, "u64": 8,
+    "s32": 4, "u32": 4, "s16": 2, "u16": 2, "s8": 1, "u8": 1, "pred": 1,
+    "f8e4m3fn": 1, "f8e5m2": 1,
+}
+
+
+def _shape_bytes(type_str: str) -> int:
+    """Total bytes of every array shape mentioned in an HLO type string
+    (handles tuples): 'f32[3,3,64,64]{...}' -> 147456."""
+    total = 0
+    for dt, dims in re.findall(r"([a-z]\w*)\[([\d,]*)\]", type_str):
+        n = 1
+        for d in filter(None, dims.split(",")):
+            n *= int(d)
+        total += n * _DTYPE_BYTES.get(dt, 4)
+    return total
+
+
+def _opcode(line: str):
+    """Opcode of an HLO instruction line ('%name = <type> opcode(...)').
+    Tuple types contain parens-free tokens like f32[8]{0}, so the first
+    lowercase identifier directly followed by '(' is the opcode."""
+    line = re.sub(r"/\*.*?\*/", "", line)
+    if "=" not in line:
+        return None, line
+    rhs = line.split("=", 1)[1]
+    m = re.search(r"([a-z][a-z0-9-]*)\(", rhs)
+    return (m.group(1) if m else None), rhs
+
+
+def analyze_hlo_schedule(hlo_text: str) -> dict:
+    """Walk the scheduled entry computation; report every collective with
+    the compute placed between its start/done pair (async) or its schedule
+    position (sync)."""
+    lines = hlo_text.splitlines()
+    # entry computation: from 'ENTRY' to the closing brace at depth 0
+    try:
+        start = next(i for i, l in enumerate(lines) if l.startswith("ENTRY"))
+    except StopIteration:
+        return {"error": "no ENTRY computation found"}
+    body = []
+    for line in lines[start + 1:]:
+        if line.startswith("}"):
+            break
+        if re.match(r"\s*(%|ROOT)", line):
+            body.append(line)
+
+    ops = []
+    for i, line in enumerate(body):
+        op, rhs = _opcode(line)
+        if op is None:
+            continue
+        name_m = re.match(r"\s*(?:ROOT\s+)?(%[\w.\-]+)", line)
+        ops.append({
+            "i": i,
+            "name": name_m.group(1) if name_m else f"<{i}>",
+            "op": op,
+            "bytes": _shape_bytes(rhs.split(op + "(", 1)[0]),
+            "rhs": rhs,  # untruncated, for operand parsing
+        })
+
+    compute_idx = [o["i"] for o in ops if o["op"] in COMPUTE_OPS]
+    collectives = []
+    starts = {}
+    unmatched_done = 0
+    for o in ops:
+        if o["op"].endswith("-start"):
+            starts[o["name"]] = o
+        elif o["op"].endswith("-done"):
+            # operand of -done is the matching -start instruction
+            operand = re.search(r"\((%[\w.\-]+)", o["rhs"])
+            s = starts.get(operand.group(1)) if operand else None
+            if s is None:
+                unmatched_done += 1
+                continue
+            between = [i for i in compute_idx if s["i"] < i < o["i"]]
+            collectives.append({
+                "kind": s["op"],
+                # the -start type tuple holds input AND output buffers;
+                # the -done type is the result alone = the payload
+                "bytes": o["bytes"],
+                "async": True,
+                "start_pos": s["i"],
+                "done_pos": o["i"],
+                "compute_ops_between": len(between),
+                "overlapped": len(between) > 0,
+            })
+        elif o["op"] in COLLECTIVE_OPS:
+            after = [i for i in compute_idx if i > o["i"]]
+            collectives.append({
+                "kind": o["op"],
+                "bytes": o["bytes"],
+                "async": False,
+                "pos": o["i"],
+                "schedule_len": len(body),
+                "compute_ops_after": len(after),
+            })
+
+    return {
+        "instructions": len(body),
+        "compute_instructions": len(compute_idx),
+        "collectives": collectives,
+        "n_async": sum(1 for c in collectives if c["async"]),
+        "n_async_overlapped": sum(
+            1 for c in collectives if c.get("overlapped")
+        ),
+        "n_sync": sum(1 for c in collectives if not c["async"]),
+        "unmatched_done": unmatched_done,
+    }
+
+
+# ---------------------------------------------------------------- build step
+
+def _build_step(args, mesh):
+    import jax
+    import jax.numpy as jnp
+
+    from ps_pytorch_tpu.data import make_preprocessor
+    from ps_pytorch_tpu.models import build_model, input_shape_for
+    from ps_pytorch_tpu.optim import sgd
+    from ps_pytorch_tpu.parallel.ps import (
+        PSConfig,
+        init_ps_state,
+        make_ps_train_step,
+    )
+
+    cfg = PSConfig(
+        num_workers=args.workers,
+        compress=args.compress,
+        num_aggregate=args.num_aggregate,
+    )
+    net = build_model(args.network, num_classes=10)
+    tx = sgd(0.1, momentum=0.9)
+    state = init_ps_state(
+        net, tx, cfg, jax.random.key(0), input_shape_for(args.network)
+    )
+    pre = make_preprocessor(args.dataset, train=True)
+    step = make_ps_train_step(net, tx, cfg, mesh, preprocess=pre)
+    h, w, c = input_shape_for(args.network)
+    batch = {
+        "image": jnp.zeros((args.batch, h, w, c), jnp.uint8),
+        "label": jnp.zeros((args.batch,), jnp.int32),
+    }
+    return step, state, batch
+
+
+def run_hlo(args) -> dict:
+    import jax
+
+    from ps_pytorch_tpu.parallel.mesh import make_mesh
+
+    mesh = make_mesh(num_workers=args.workers)
+    step, state, batch = _build_step(args, mesh)
+    txt = step.lower(state, batch, jax.random.key(1)).compile().as_text()
+    rep = analyze_hlo_schedule(txt)
+    rep["mode"] = "hlo"
+    rep["backend"] = jax.default_backend()
+    rep["workers"] = args.workers
+    return rep
+
+
+def run_topology(args) -> dict:
+    """AOT-compile the N-chip TPU program via a PJRT topology description —
+    the TPU compiler does the real scheduling, no chips needed."""
+    import jax
+    from jax.experimental import topologies
+
+    last_err = None
+    for name in ([args.topology] if args.topology else
+                 [f"v5e:{args.workers}", f"v5litepod-{args.workers}",
+                  f"v5e:2x{args.workers // 2}"]):
+        try:
+            topo = topologies.get_topology_desc(name, "tpu")
+            break
+        except Exception as e:  # try the next naming convention
+            last_err = e
+            topo = None
+    if topo is None:
+        return {"mode": "topology", "error": f"{type(last_err).__name__}: {last_err}"}
+
+    from ps_pytorch_tpu.parallel.mesh import WORKER_AXIS
+
+    mesh = topologies.make_mesh(topo, (args.workers,), (WORKER_AXIS,))
+    step, state, batch = _build_step(args, mesh)
+    state_sds = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), state
+    )
+    batch_sds = jax.tree.map(
+        lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype), batch
+    )
+    k = jax.random.key(1)
+    key_sds = jax.ShapeDtypeStruct(k.shape, k.dtype)  # typed PRNG key
+    try:
+        lowered = step.lower(state, batch, k)
+    except Exception:
+        lowered = step.lower(state_sds, batch_sds, key_sds)
+    txt = lowered.compile().as_text()
+    rep = analyze_hlo_schedule(txt)
+    rep["mode"] = "topology"
+    rep["topology"] = str(topo)
+    rep["workers"] = args.workers
+    return rep
+
+
+def run_trace(args) -> dict:
+    """Wall-clock overlap from a --profile-dir run's Chrome trace: fraction
+    of collective-event time that coincides with compute events on the
+    device timeline."""
+    pats = sorted(glob.glob(
+        os.path.join(args.profile_dir, "**", "*.trace.json.gz"),
+        recursive=True,
+    ))
+    if not pats:
+        return {"mode": "trace", "error": f"no trace.json.gz under {args.profile_dir}"}
+    data = json.load(gzip.open(pats[-1], "rt"))
+    evs = data.get("traceEvents", [])
+    pid_names = {
+        e["pid"]: e["args"]["name"]
+        for e in evs
+        if e.get("ph") == "M" and e.get("name") == "process_name"
+        and isinstance(e.get("args"), dict) and "name" in e["args"]
+    }
+    device_pids = {
+        p for p, n in pid_names.items()
+        if "TPU" in n or "/device" in n.lower() or "XLA" in n
+    }
+    spans = [
+        e for e in evs
+        if e.get("ph") == "X" and e.get("pid") in device_pids
+        and e.get("dur") is not None
+    ]
+    is_coll = lambda n: any(
+        k in n.lower()
+        for k in ("all-reduce", "all_reduce", "allreduce", "all-gather",
+                  "all_gather", "reduce-scatter", "reduce_scatter",
+                  "collective", "all-to-all", "psum")
+    )
+    coll = [(e["ts"], e["ts"] + e["dur"]) for e in spans if is_coll(e["name"])]
+    comp = [
+        (e["ts"], e["ts"] + e["dur"]) for e in spans if not is_coll(e["name"])
+    ]
+
+    def _merge(iv):
+        out = []
+        for s, t in sorted(iv):
+            if out and s <= out[-1][1]:
+                out[-1] = (out[-1][0], max(out[-1][1], t))
+            else:
+                out.append((s, t))
+        return out
+
+    def _inter(a, b):
+        i = j = 0
+        tot = 0.0
+        while i < len(a) and j < len(b):
+            s = max(a[i][0], b[j][0])
+            t = min(a[i][1], b[j][1])
+            if s < t:
+                tot += t - s
+            if a[i][1] < b[j][1]:
+                i += 1
+            else:
+                j += 1
+        return tot
+
+    cm, pm = _merge(coll), _merge(comp)
+    coll_time = sum(t - s for s, t in cm)
+    overlap = _inter(cm, pm)
+    return {
+        "mode": "trace",
+        "trace_file": pats[-1],
+        "device_pids": sorted(device_pids),
+        "n_collective_events": len(coll),
+        "n_compute_events": len(comp),
+        "collective_ms": round(coll_time / 1e3, 3),
+        "overlapped_ms": round(overlap / 1e3, 3),
+        "overlap_fraction": round(overlap / coll_time, 4) if coll_time else None,
+    }
+
+
+def main(argv=None):
+    p = argparse.ArgumentParser(__doc__)
+    p.add_argument("mode", choices=["hlo", "trace", "topology"])
+    p.add_argument("--workers", type=int, default=8)
+    p.add_argument("--network", default="ResNet18")
+    p.add_argument("--dataset", default="Cifar10")
+    p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--compress", default=None)
+    p.add_argument("--num-aggregate", type=int, default=None)
+    p.add_argument("--profile-dir", default=None)
+    p.add_argument("--topology", default=None)
+    p.add_argument("--out", default=None)
+    args = p.parse_args(argv)
+
+    rep = {"hlo": run_hlo, "trace": run_trace, "topology": run_topology}[
+        args.mode
+    ](args)
+    print(json.dumps(rep, indent=2))
+    if args.out:
+        if os.path.dirname(args.out):
+            os.makedirs(os.path.dirname(args.out), exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(rep, f, indent=2)
+    return rep
+
+
+if __name__ == "__main__":
+    main()

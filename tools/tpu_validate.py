@@ -374,7 +374,8 @@ def main(argv=None):
     assert all(q["within_bound"] for q in report["quantizers"])
     assert all(r["ok"] for r in report["ring_flash"])
 
-    os.makedirs(os.path.dirname(args.out), exist_ok=True)
+    if os.path.dirname(args.out):
+        os.makedirs(os.path.dirname(args.out), exist_ok=True)
     with open(args.out, "w") as f:
         json.dump(report, f, indent=2)
     print(f"report -> {args.out}")
