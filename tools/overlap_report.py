@@ -124,11 +124,17 @@ def analyze_hlo_schedule(hlo_text: str) -> dict:
     collectives = []
     starts = {}
     unmatched_done = 0
+    collective_kinds = {k for k in COLLECTIVE_OPS if not k.endswith(("-start", "-done"))}
     for o in ops:
         if o["op"].endswith("-start"):
-            starts[o["name"]] = o
+            # only collective pairs count — XLA also emits async
+            # copy-start/copy-done etc., which move no collective traffic
+            if o["op"][: -len("-start")] in collective_kinds:
+                starts[o["name"]] = o
         elif o["op"].endswith("-done"):
             # operand of -done is the matching -start instruction
+            if o["op"][: -len("-done")] not in collective_kinds:
+                continue  # async copy etc. — not comm
             operand = re.search(r"\((%[\w.\-]+)", o["rhs"])
             s = starts.get(operand.group(1)) if operand else None
             if s is None:
@@ -267,6 +273,8 @@ def run_trace(args) -> dict:
     """Wall-clock overlap from a --profile-dir run's Chrome trace: fraction
     of collective-event time that coincides with compute events on the
     device timeline."""
+    if not args.profile_dir:
+        return {"mode": "trace", "error": "--profile-dir is required"}
     pats = sorted(glob.glob(
         os.path.join(args.profile_dir, "**", "*.trace.json.gz"),
         recursive=True,
@@ -340,7 +348,7 @@ def run_trace(args) -> dict:
 
 
 def main(argv=None):
-    p = argparse.ArgumentParser(__doc__)
+    p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("mode", choices=["hlo", "trace", "topology"])
     p.add_argument("--workers", type=int, default=8)
     p.add_argument("--network", default="ResNet18")

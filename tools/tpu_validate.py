@@ -334,7 +334,7 @@ def bench_ring_flash(quick):
 
 
 def main(argv=None):
-    p = argparse.ArgumentParser(__doc__)
+    p = argparse.ArgumentParser(description=__doc__)
     p.add_argument("--seq-lens", type=int, nargs="+",
                    default=[1000, 1024, 2048, 4096, 8192])
     # T=1000 exercises the pad-and-mask path (odd length -> 1024 grid with

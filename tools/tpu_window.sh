@@ -1,0 +1,88 @@
+#!/bin/bash
+# Drain the staged TPU work queue during a live-tunnel window.
+#
+# Windows are short (~25 min observed, runs/tpu_r03/NOTES.md) and can die
+# mid-step, so: priority order, per-step timeouts, every step banks its
+# artifact immediately and a failure does not stop the queue. Re-running
+# after a partial window is safe — the persistent compile cache
+# (/tmp/ps_tpu_jax_cache) makes already-banked steps cheap to re-verify.
+#
+# Usage:  bash tools/tpu_window.sh [outdir]     # default runs/tpu_r03
+set -u
+cd "$(dirname "$0")/.."
+OUT=${1:-runs/tpu_r03}
+mkdir -p "$OUT"
+log() { echo "[tpu_window $(date -u +%H:%M:%S)] $*"; }
+
+# 0. is the tunnel actually up?
+if ! timeout 280 python -c "import jax; assert jax.default_backend()=='tpu', jax.default_backend()"; then
+  log "tunnel down (device init hung or non-TPU backend); aborting"
+  exit 1
+fi
+log "tunnel UP"
+
+# 1. headline bench records (fast once cached; re-banks if the window died
+#    before a record landed)
+for spec in "lenet:" "resnet18:" "lm:"; do
+  wl=${spec%%:*}
+  f="$OUT/bench_${wl}$( [ "$wl" = lm ] && echo _1k ).json"
+  log "bench $wl -> $f"
+  BENCH_WORKLOAD=$wl timeout 580 python bench.py >"$f.tmp" 2>"$OUT/bench_${wl}.err" \
+    && grep -q '"device": "TPU' "$f.tmp" && mv "$f.tmp" "$f" \
+    || { log "bench $wl: no TPU record (see $OUT/bench_${wl}.err)"; rm -f "$f.tmp"; }
+done
+
+# 2. long-context LM: seq 8192 + flash, b=2 (b=8 x depth=6 hangs the
+#    remote-compile helper — bisection in $OUT/NOTES.md)
+log "bench lm seq8192 flash b2"
+BENCH_WORKLOAD=lm BENCH_LM_SEQ=8192 BENCH_LM_FLASH=1 BENCH_LM_BATCH=2 \
+  timeout 580 python bench.py >"$OUT/bench_lm_8k_flash.json.tmp" 2>"$OUT/bench_lm_8k_flash.err" \
+  && grep -q '"device": "TPU' "$OUT/bench_lm_8k_flash.json.tmp" \
+  && mv "$OUT/bench_lm_8k_flash.json.tmp" "$OUT/bench_lm_8k_flash.json" \
+  || { log "lm 8k flash: no TPU record"; rm -f "$OUT/bench_lm_8k_flash.json.tmp"; }
+
+# 3. compiled Pallas validation, quick first (banks a full compiled-parity
+#    report fast), then the full sweep incl. T=1000 pad-and-mask
+log "tpu_validate quick"
+timeout 580 python tools/tpu_validate.py --quick --seq-lens 1000 2048 \
+  --out "$OUT/tpu_validate_quick.json" 2>"$OUT/tpu_validate_quick.err" \
+  || log "tpu_validate quick FAILED (see $OUT/tpu_validate_quick.err)"
+log "tpu_validate full"
+timeout 1800 python tools/tpu_validate.py --out "$OUT/tpu_validate.json" \
+  2>"$OUT/tpu_validate.err" \
+  || log "tpu_validate full FAILED (see $OUT/tpu_validate.err)"
+
+# 4. profile trace of single-chip ResNet18 PS training + timeline analysis
+log "profile trace"
+rm -rf "$OUT/profile"
+timeout 580 python -m ps_pytorch_tpu.cli.train --network ResNet18 \
+  --dataset Cifar10 --num-workers 1 --batch-size 256 \
+  --max-steps 16 --eval-freq 1000 --profile-dir "$OUT/profile" \
+  >"$OUT/profile_train.log" 2>&1 \
+  || log "profile train FAILED (see $OUT/profile_train.log)"
+timeout 280 python tools/overlap_report.py trace --profile-dir "$OUT/profile" \
+  --out "$OUT/overlap_trace.json" || log "trace analysis failed"
+
+# 5. AOT topology compile of the 8-chip program (the component-#12 prize:
+#    real TPU compiler schedule without 8 chips) — may be unsupported by
+#    the tunnel plugin; the error record is evidence either way
+log "topology AOT"
+timeout 580 python tools/overlap_report.py topology --workers 8 \
+  --out "$OUT/overlap_topology.json" 2>"$OUT/overlap_topology.err" \
+  || log "topology AOT failed (see $OUT/overlap_topology.err)"
+
+# 6. MFU scaling probe: larger LM configs (stated target: >=40% MFU on LM;
+#    d512x6 measured 22% — bigger matmuls should close the gap)
+for cfg in "1024:8:2048:4" "2048:4:2048:2"; do
+  IFS=: read -r dim depth seq batch <<<"$cfg"
+  f="$OUT/bench_lm_d${dim}x${depth}_s${seq}.json"
+  log "bench lm d${dim}x${depth} s${seq} b${batch} -> $f"
+  BENCH_WORKLOAD=lm BENCH_LM_DIM=$dim BENCH_LM_DEPTH=$depth \
+    BENCH_LM_SEQ=$seq BENCH_LM_BATCH=$batch BENCH_LM_FLASH=1 \
+    timeout 580 python bench.py >"$f.tmp" 2>"${f%.json}.err" \
+    && grep -q '"device": "TPU' "$f.tmp" && mv "$f.tmp" "$f" \
+    || { log "lm d$dim: no TPU record"; rm -f "$f.tmp"; }
+done
+
+log "window drained; artifacts in $OUT:"
+ls -la "$OUT"
