@@ -63,6 +63,26 @@ def _lm_env(name: str) -> int:
     return int(os.environ.get(f"BENCH_LM_{name}", _LM_DEFAULTS[name]))
 
 
+# single source for the BENCH_DTYPE contract, shared by _validate_env,
+# _bench_dtype, _lm_tag, and the error-record tagging — these must agree
+# or a failed run's metric key diverges from its success key
+_BENCH_DTYPES = ("float32", "bfloat16")
+_LM_DTYPE_DEFAULT = "bfloat16"  # MXU-native; CNNs default float32 (parity)
+_CNN_DTYPE_DEFAULT = "float32"
+
+
+def _bench_dtype(jnp, default: str):
+    """(name, jnp dtype) from BENCH_DTYPE (validated by _validate_env
+    before backend init; re-checked here for library callers)."""
+    name = os.environ.get("BENCH_DTYPE", default)
+    table = {"float32": jnp.float32, "bfloat16": jnp.bfloat16}
+    if name not in table:
+        raise SystemExit(
+            f"BENCH_DTYPE must be one of {sorted(table)}, got {name!r}"
+        )
+    return name, table[name]
+
+
 def _lm_tag() -> str:
     """The lm metric's shape tag, derived from the SAME BENCH_LM_* envs
     (and defaults) the workload reads."""
@@ -74,7 +94,17 @@ def _lm_tag() -> str:
         tag += "_flash"
     if _lm_env("SP") > 1:
         tag += f"_sp{_lm_env('SP')}"
+    if os.environ.get("BENCH_DTYPE", _LM_DTYPE_DEFAULT) == "float32":
+        tag += "_f32"
     return tag
+
+
+def _cnn_dtype_suffix() -> str:
+    """Metric-key dtype tag for the CNN workloads (success AND error
+    records must share it)."""
+    if os.environ.get("BENCH_DTYPE", _CNN_DTYPE_DEFAULT) == "bfloat16":
+        return "_bf16"
+    return ""
 
 
 def _bench_lm(steps: int) -> tuple:
@@ -101,6 +131,7 @@ def _bench_lm(steps: int) -> tuple:
     batch = _lm_env("BATCH")
     seq = _lm_env("SEQ")
     n_sp = _lm_env("SP")
+    _, lm_dtype = _bench_dtype(jnp, _LM_DTYPE_DEFAULT)
     cfg = TransformerConfig(
         vocab_size=2048,
         dim=_lm_env("DIM"),
@@ -108,7 +139,7 @@ def _bench_lm(steps: int) -> tuple:
         heads=8,
         max_seq_len=seq,
         remat=True,
-        compute_dtype=jnp.bfloat16,
+        compute_dtype=lm_dtype,
         attention_impl=(
             "flash" if os.environ.get("BENCH_LM_FLASH") == "1" else "naive"
         ),
@@ -188,7 +219,23 @@ def _mfu(flops_per_step, steps, elapsed, jax, n_devices) -> float | None:
 
 
 
+def _validate_env() -> None:
+    """Fail bad knobs BEFORE the backend probe/init — the tunnel handshake
+    is the slow part, and a typo must not burn minutes of a live window."""
+    if os.environ.get("BENCH_DTYPE") not in (None, *_BENCH_DTYPES):
+        raise SystemExit(
+            f"BENCH_DTYPE must be one of {list(_BENCH_DTYPES)}, "
+            f"got {os.environ['BENCH_DTYPE']!r}"
+        )
+    if os.environ.get("BENCH_WORKLOAD", "lenet") not in WORKLOADS:
+        raise SystemExit(
+            f"BENCH_WORKLOAD must be one of {sorted(WORKLOADS)}, "
+            f"got {os.environ['BENCH_WORKLOAD']!r}"
+        )
+
+
 def main() -> None:
+    _validate_env()
     import jax
 
     from ps_pytorch_tpu.utils import enable_persistent_compile_cache
@@ -239,7 +286,13 @@ def main() -> None:
         return
     mesh = make_mesh(num_workers=n_dev)
     cfg = PSConfig(num_workers=n_dev, compress=w["compress"])
-    model = build_model(w["network"])
+    # BENCH_DTYPE=bfloat16 reports the MXU-native mixed-precision config
+    # (params stay f32, same as the trainer's --dtype flag); the default
+    # stays f32 for like-for-like comparison with the reference's math
+    import jax.numpy as jnp
+
+    _, cnn_dtype = _bench_dtype(jnp, _CNN_DTYPE_DEFAULT)
+    model = build_model(w["network"], dtype=cnn_dtype)
     tx = sgd(0.01, momentum=0.9)
     shape = IMAGE_SHAPES[w["dataset"]]
     state = init_ps_state(model, tx, cfg, jax.random.key(0), shape)
@@ -282,7 +335,7 @@ def main() -> None:
     print(
         json.dumps(
             {
-                "metric": w["metric"] + suffix,
+                "metric": w["metric"] + _cnn_dtype_suffix() + suffix,
                 "value": round(images_per_sec, 1),
                 "unit": "images/sec",
                 "vs_baseline": round(images_per_sec / REF_IMAGES_PER_SEC, 2),
@@ -322,7 +375,10 @@ def _emit_error_record(err: str) -> None:
         # same tag construction as the success path => same metric key
         metric = f"lm_{_lm_tag()}_train_tokens_per_sec"
     else:
-        metric = WORKLOADS.get(name, {}).get("metric") or f"{name}_train_throughput"
+        metric = (
+            WORKLOADS.get(name, {}).get("metric")
+            or f"{name}_train_throughput"
+        ) + _cnn_dtype_suffix()
     if os.environ.get("BENCH_CPU_FALLBACK") == "1":
         metric += "_cpu_fallback"  # keep error keys aligned with success keys
     print(
@@ -386,6 +442,7 @@ if __name__ == "__main__":
     # backend init fails fast or succeeds, so skip the probe's extra
     # backend-init cost on ordinary healthy hosts
     plugin_present = bool(os.environ.get("PALLAS_AXON_POOL_IPS"))
+    _validate_env()  # cheap; must precede the (up to 240s) backend probe
     if not ambient_cpu and plugin_present and not _backend_alive():
         _cpu_fallback_or_error("accelerator backend init failed or hung")
     try:

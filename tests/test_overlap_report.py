@@ -96,5 +96,25 @@ ENTRY %main () -> f32[4] {
     assert rep["unmatched_done"] == 0
 
 
+def test_analyze_schedule_generic_async_wrapper():
+    # collectives without dedicated -start ops ship as generic async-start
+    # wrappers naming the wrapped op; these must still count as comm
+    hlo = """\
+ENTRY %main () -> f32[4] {
+  %x = f32[8]{0} parameter(0)
+  %async-start.1 = ((f32[8]{0}), f32[4]{0}, u32[]) async-start(%x), calls=%wrapped_reduce_scatter.3
+  %fusion.1 = f32[8]{0} fusion(%x), kind=kLoop
+  %async-done.1 = f32[4]{0} async-done(%async-start.1)
+  ROOT %copy.1 = f32[4]{0} copy(%async-done.1)
+}
+"""
+    rep = orp.analyze_hlo_schedule(hlo)
+    assert rep["n_async"] == 1
+    a = rep["collectives"][0]
+    assert a["kind"] == "reduce-scatter"
+    assert a["compute_ops_between"] == 1
+    assert a["bytes"] == 4 * 4  # -done result f32[4]
+
+
 def test_analyze_schedule_no_entry():
     assert "error" in orp.analyze_hlo_schedule("HloModule empty")

@@ -125,24 +125,42 @@ def analyze_hlo_schedule(hlo_text: str) -> dict:
     starts = {}
     unmatched_done = 0
     collective_kinds = {k for k in COLLECTIVE_OPS if not k.endswith(("-start", "-done"))}
+
+    def _async_kind(o):
+        """Collective kind of an async -start/-done instruction, or None.
+        Handles both dedicated ops (all-reduce-start) and XLA's generic
+        wrappers (async-start ... calls=%wrapped_reduce_scatter), where the
+        wrapped collective's name appears in the instruction text. Plain
+        async copies etc. return None — they move no collective traffic."""
+        base = o["op"].rsplit("-", 1)[0]
+        if base in collective_kinds:
+            return base
+        if base == "async":
+            # only the calls= target names the wrapped op — operand names
+            # and metadata can mention collectives without being one
+            called = re.search(r"calls=(%[\w.\-]+)", o["rhs"])
+            if called:
+                tok = called.group(1)
+                for k in sorted(collective_kinds, key=len, reverse=True):
+                    if k in tok or k.replace("-", "_") in tok:
+                        return k
+        return None
+
     for o in ops:
         if o["op"].endswith("-start"):
-            # only collective pairs count — XLA also emits async
-            # copy-start/copy-done etc., which move no collective traffic
-            if o["op"][: -len("-start")] in collective_kinds:
+            if _async_kind(o) is not None:
                 starts[o["name"]] = o
         elif o["op"].endswith("-done"):
             # operand of -done is the matching -start instruction
-            if o["op"][: -len("-done")] not in collective_kinds:
-                continue  # async copy etc. — not comm
             operand = re.search(r"\((%[\w.\-]+)", o["rhs"])
             s = starts.get(operand.group(1)) if operand else None
             if s is None:
-                unmatched_done += 1
+                if _async_kind(o) is not None:
+                    unmatched_done += 1
                 continue
             between = [i for i in compute_idx if s["i"] < i < o["i"]]
             collectives.append({
-                "kind": s["op"],
+                "kind": _async_kind(s) or s["op"],
                 # the -start type tuple holds input AND output buffers;
                 # the -done type is the result alone = the payload
                 "bytes": o["bytes"],

@@ -14,6 +14,20 @@ OUT=${1:-runs/tpu_r03}
 mkdir -p "$OUT"
 log() { echo "[tpu_window $(date -u +%H:%M:%S)] $*"; }
 
+# bank_bench <outfile-stem> [ENV=val ...] — run bench.py under the given
+# env, keep the JSON only if it is a real-TPU record (not a CPU fallback)
+bank_bench() {
+  local stem="$1"; shift
+  log "bench $stem"
+  if env "$@" timeout 580 python bench.py >"$OUT/$stem.json.tmp" 2>"$OUT/$stem.err" \
+     && grep -q '"device": "TPU' "$OUT/$stem.json.tmp"; then
+    mv "$OUT/$stem.json.tmp" "$OUT/$stem.json"
+  else
+    log "bench $stem: no TPU record (see $OUT/$stem.err)"
+    rm -f "$OUT/$stem.json.tmp"
+  fi
+}
+
 # 0. is the tunnel actually up?
 if ! timeout 280 python -c "import jax; assert jax.default_backend()=='tpu', jax.default_backend()"; then
   log "tunnel down (device init hung or non-TPU backend); aborting"
@@ -23,23 +37,14 @@ log "tunnel UP"
 
 # 1. headline bench records (fast once cached; re-banks if the window died
 #    before a record landed)
-for spec in "lenet:" "resnet18:" "lm:"; do
-  wl=${spec%%:*}
-  f="$OUT/bench_${wl}$( [ "$wl" = lm ] && echo _1k ).json"
-  log "bench $wl -> $f"
-  BENCH_WORKLOAD=$wl timeout 580 python bench.py >"$f.tmp" 2>"$OUT/bench_${wl}.err" \
-    && grep -q '"device": "TPU' "$f.tmp" && mv "$f.tmp" "$f" \
-    || { log "bench $wl: no TPU record (see $OUT/bench_${wl}.err)"; rm -f "$f.tmp"; }
-done
+bank_bench bench_lenet BENCH_WORKLOAD=lenet
+bank_bench bench_resnet18 BENCH_WORKLOAD=resnet18
+bank_bench bench_lm_1k BENCH_WORKLOAD=lm
 
 # 2. long-context LM: seq 8192 + flash, b=2 (b=8 x depth=6 hangs the
 #    remote-compile helper — bisection in $OUT/NOTES.md)
-log "bench lm seq8192 flash b2"
-BENCH_WORKLOAD=lm BENCH_LM_SEQ=8192 BENCH_LM_FLASH=1 BENCH_LM_BATCH=2 \
-  timeout 580 python bench.py >"$OUT/bench_lm_8k_flash.json.tmp" 2>"$OUT/bench_lm_8k_flash.err" \
-  && grep -q '"device": "TPU' "$OUT/bench_lm_8k_flash.json.tmp" \
-  && mv "$OUT/bench_lm_8k_flash.json.tmp" "$OUT/bench_lm_8k_flash.json" \
-  || { log "lm 8k flash: no TPU record"; rm -f "$OUT/bench_lm_8k_flash.json.tmp"; }
+bank_bench bench_lm_8k_flash BENCH_WORKLOAD=lm BENCH_LM_SEQ=8192 \
+  BENCH_LM_FLASH=1 BENCH_LM_BATCH=2
 
 # 3. compiled Pallas validation, quick first (banks a full compiled-parity
 #    report fast), then the full sweep incl. T=1000 pad-and-mask
@@ -71,18 +76,17 @@ timeout 580 python tools/overlap_report.py topology --workers 8 \
   --out "$OUT/overlap_topology.json" 2>"$OUT/overlap_topology.err" \
   || log "topology AOT failed (see $OUT/overlap_topology.err)"
 
+# 5b. MXU-native mixed-precision CNN record (params f32, compute bf16 —
+#     the trainer's --dtype bfloat16 config; default record stays f32 for
+#     like-for-like math vs the reference)
+bank_bench bench_resnet18_bf16 BENCH_WORKLOAD=resnet18 BENCH_DTYPE=bfloat16
+
 # 6. MFU scaling probe: larger LM configs (stated target: >=40% MFU on LM;
 #    d512x6 measured 22% — bigger matmuls should close the gap)
-for cfg in "1024:8:2048:4" "2048:4:2048:2"; do
-  IFS=: read -r dim depth seq batch <<<"$cfg"
-  f="$OUT/bench_lm_d${dim}x${depth}_s${seq}.json"
-  log "bench lm d${dim}x${depth} s${seq} b${batch} -> $f"
-  BENCH_WORKLOAD=lm BENCH_LM_DIM=$dim BENCH_LM_DEPTH=$depth \
-    BENCH_LM_SEQ=$seq BENCH_LM_BATCH=$batch BENCH_LM_FLASH=1 \
-    timeout 580 python bench.py >"$f.tmp" 2>"${f%.json}.err" \
-    && grep -q '"device": "TPU' "$f.tmp" && mv "$f.tmp" "$f" \
-    || { log "lm d$dim: no TPU record"; rm -f "$f.tmp"; }
-done
+bank_bench bench_lm_d1024x8_s2048 BENCH_WORKLOAD=lm BENCH_LM_DIM=1024 \
+  BENCH_LM_DEPTH=8 BENCH_LM_SEQ=2048 BENCH_LM_BATCH=4 BENCH_LM_FLASH=1
+bank_bench bench_lm_d2048x4_s2048 BENCH_WORKLOAD=lm BENCH_LM_DIM=2048 \
+  BENCH_LM_DEPTH=4 BENCH_LM_SEQ=2048 BENCH_LM_BATCH=2 BENCH_LM_FLASH=1
 
 log "window drained; artifacts in $OUT:"
 ls -la "$OUT"
