@@ -219,6 +219,42 @@ def _mfu(flops_per_step, steps, elapsed, jax, n_devices) -> float | None:
 
 
 
+def _last_tpu_record(expected_metric: str):
+    """Most recent banked real-hardware record whose metric key MATCHES the
+    current run's (same workload, same shape/dtype tags — see
+    tools/tpu_window.sh), or None. Attached to CPU-fallback records so a
+    dead tunnel at measurement time still surfaces the hardware evidence —
+    clearly dated and separate from the fallback value, never substituted
+    for it."""
+    import datetime
+    import glob as _glob
+
+    here = os.path.dirname(os.path.abspath(__file__))
+    best = None
+    for path in _glob.glob(os.path.join(here, "runs", "tpu_*", "bench_*.json")):
+        try:
+            with open(path) as f:
+                rec = json.load(f)
+            if "TPU" not in str(rec.get("device", "")):
+                continue
+            if rec.get("metric") != expected_metric:
+                continue
+            mtime = os.path.getmtime(path)
+            if best is None or mtime > best[0]:
+                best = (mtime, rec, path)
+        except (OSError, ValueError):
+            continue
+    if best is None:
+        return None
+    mtime, rec, path = best
+    rec = dict(rec)
+    rec["recorded"] = datetime.datetime.fromtimestamp(
+        mtime, datetime.timezone.utc
+    ).strftime("%Y-%m-%dT%H:%M:%SZ")
+    rec["source"] = os.path.relpath(path, here)
+    return rec
+
+
 def _validate_env() -> None:
     """Fail bad knobs BEFORE the backend probe/init — the tunnel handshake
     is the slow part, and a typo must not burn minutes of a live window."""
@@ -266,18 +302,19 @@ def main() -> None:
         steps = int(os.environ.get("BENCH_STEPS", 20))
         tokens_per_sec, loss, elapsed, shape_tag, flops, lm_dev = _bench_lm(steps)
         assert np.isfinite(loss), f"non-finite loss {loss}"
-        print(
-            json.dumps(
-                {
-                    "metric": f"lm_{shape_tag}_train_tokens_per_sec{suffix}",
-                    "value": round(tokens_per_sec, 1),
-                    "unit": "tokens/sec",
-                    "vs_baseline": round(tokens_per_sec / REF_IMAGES_PER_SEC, 2),
-                    "mfu": _mfu(flops, steps, elapsed, jax, n_devices=lm_dev),
-                    "device": device_kind,
-                }
-            )
-        )
+        rec = {
+            "metric": f"lm_{shape_tag}_train_tokens_per_sec{suffix}",
+            "value": round(tokens_per_sec, 1),
+            "unit": "tokens/sec",
+            "vs_baseline": round(tokens_per_sec / REF_IMAGES_PER_SEC, 2),
+            "mfu": _mfu(flops, steps, elapsed, jax, n_devices=lm_dev),
+            "device": device_kind,
+        }
+        if fallback and (
+            banked := _last_tpu_record(f"lm_{shape_tag}_train_tokens_per_sec")
+        ):
+            rec["last_tpu_record"] = banked
+        print(json.dumps(rec))
         print(
             f"# 1 device (1x1 mesh), {elapsed:.2f}s for {steps} LM steps, "
             f"final loss {loss:.4f}",
@@ -332,18 +369,19 @@ def main() -> None:
 
     images_per_sec = steps * w["batch"] / elapsed
     assert np.isfinite(loss), f"non-finite loss {loss}"
-    print(
-        json.dumps(
-            {
-                "metric": w["metric"] + _cnn_dtype_suffix() + suffix,
-                "value": round(images_per_sec, 1),
-                "unit": "images/sec",
-                "vs_baseline": round(images_per_sec / REF_IMAGES_PER_SEC, 2),
-                "mfu": _mfu(flops, steps, elapsed, jax, n_devices=n_dev),
-                "device": device_kind,
-            }
-        )
-    )
+    rec = {
+        "metric": w["metric"] + _cnn_dtype_suffix() + suffix,
+        "value": round(images_per_sec, 1),
+        "unit": "images/sec",
+        "vs_baseline": round(images_per_sec / REF_IMAGES_PER_SEC, 2),
+        "mfu": _mfu(flops, steps, elapsed, jax, n_devices=n_dev),
+        "device": device_kind,
+    }
+    if fallback and (
+        banked := _last_tpu_record(w["metric"] + _cnn_dtype_suffix())
+    ):
+        rec["last_tpu_record"] = banked
+    print(json.dumps(rec))
     print(
         f"# {n_dev} device(s), {elapsed:.2f}s for {steps} steps "
         f"(reference single node: {REF_SINGLE_NODE_SECONDS}s), final loss {loss:.4f}",
@@ -379,19 +417,19 @@ def _emit_error_record(err: str) -> None:
             WORKLOADS.get(name, {}).get("metric")
             or f"{name}_train_throughput"
         ) + _cnn_dtype_suffix()
+    success_metric = metric
     if os.environ.get("BENCH_CPU_FALLBACK") == "1":
         metric += "_cpu_fallback"  # keep error keys aligned with success keys
-    print(
-        json.dumps(
-            {
-                "metric": metric,
-                "value": None,
-                "unit": "tokens/sec" if name == "lm" else "images/sec",
-                "vs_baseline": None,
-                "error": err[:500],
-            }
-        )
-    )
+    rec = {
+        "metric": metric,
+        "value": None,
+        "unit": "tokens/sec" if name == "lm" else "images/sec",
+        "vs_baseline": None,
+        "error": err[:500],
+    }
+    if banked := _last_tpu_record(success_metric):
+        rec["last_tpu_record"] = banked
+    print(json.dumps(rec))
 
 
 def _cpu_fallback_or_error(err: str) -> None:

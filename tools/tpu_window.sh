@@ -19,8 +19,11 @@ log() { echo "[tpu_window $(date -u +%H:%M:%S)] $*"; }
 bank_bench() {
   local stem="$1"; shift
   log "bench $stem"
+  # the TOP-LEVEL device field must be TPU — a CPU-fallback record embeds
+  # the previously banked TPU record under last_tpu_record, so a substring
+  # grep would overwrite genuine hardware evidence with a fallback
   if env "$@" timeout 580 python bench.py >"$OUT/$stem.json.tmp" 2>"$OUT/$stem.err" \
-     && grep -q '"device": "TPU' "$OUT/$stem.json.tmp"; then
+     && python -c "import json,sys; sys.exit(0 if 'TPU' in str(json.load(open(sys.argv[1])).get('device','')) else 1)" "$OUT/$stem.json.tmp"; then
     mv "$OUT/$stem.json.tmp" "$OUT/$stem.json"
   else
     log "bench $stem: no TPU record (see $OUT/$stem.err)"
