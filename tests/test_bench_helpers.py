@@ -1,0 +1,112 @@
+"""Pin bench.py's record-key and evidence-attachment helpers.
+
+The driver parses bench's ONE JSON line per round; metric keys must stay
+aligned between success, error, and CPU-fallback records (and between f32
+and bf16 configs), and a fallback must never attach a banked hardware
+record from a different config. These invariants went through three
+review cycles — pinned here so they can't regress silently."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    """Fresh bench module per test (its helpers read env at call time, but
+    a clean import keeps sys.modules uncluttered)."""
+    spec = importlib.util.spec_from_file_location(
+        "bench_under_test", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_lm_tag_encodes_overrides(bench, monkeypatch):
+    monkeypatch.delenv("BENCH_DTYPE", raising=False)
+    for var in ("BATCH", "SEQ", "DIM", "DEPTH", "SP"):
+        monkeypatch.delenv(f"BENCH_LM_{var}", raising=False)
+    monkeypatch.delenv("BENCH_LM_FLASH", raising=False)
+    assert bench._lm_tag() == "d512x6_s1024_b8"
+    monkeypatch.setenv("BENCH_LM_SEQ", "8192")
+    monkeypatch.setenv("BENCH_LM_FLASH", "1")
+    monkeypatch.setenv("BENCH_LM_BATCH", "2")
+    assert bench._lm_tag() == "d512x6_s8192_b2_flash"
+    monkeypatch.setenv("BENCH_DTYPE", "float32")
+    assert bench._lm_tag().endswith("_f32")
+
+
+def test_cnn_dtype_suffix_matches_contract(bench, monkeypatch):
+    monkeypatch.delenv("BENCH_DTYPE", raising=False)
+    assert bench._cnn_dtype_suffix() == ""
+    monkeypatch.setenv("BENCH_DTYPE", "bfloat16")
+    assert bench._cnn_dtype_suffix() == "_bf16"
+    monkeypatch.setenv("BENCH_DTYPE", "float32")
+    assert bench._cnn_dtype_suffix() == ""
+
+
+def test_validate_env_rejects_bad_knobs(bench, monkeypatch):
+    monkeypatch.setenv("BENCH_DTYPE", "bf16")
+    with pytest.raises(SystemExit):
+        bench._validate_env()
+    monkeypatch.setenv("BENCH_DTYPE", "bfloat16")
+    monkeypatch.setenv("BENCH_WORKLOAD", "nope")
+    with pytest.raises(SystemExit):
+        bench._validate_env()
+    monkeypatch.setenv("BENCH_WORKLOAD", "lm")
+    bench._validate_env()  # no raise
+
+
+def test_last_tpu_record_matches_metric_exactly(bench, tmp_path, monkeypatch):
+    # point the repo-relative runs/ glob at a temp tree via __file__ patching
+    (tmp_path / "runs" / "tpu_r99").mkdir(parents=True)
+    rec_dir = tmp_path / "runs" / "tpu_r99"
+    (rec_dir / "bench_resnet18.json").write_text(json.dumps({
+        "metric": "resnet18_cifar10_b1024_train_throughput",
+        "value": 15298.6, "device": "TPU v5 lite",
+    }))
+    (rec_dir / "bench_resnet18_bf16.json").write_text(json.dumps({
+        "metric": "resnet18_cifar10_b1024_train_throughput_bf16",
+        "value": 30000.0, "device": "TPU v5 lite",
+    }))
+    (rec_dir / "bench_cpu.json").write_text(json.dumps({
+        "metric": "resnet18_cifar10_b1024_train_throughput",
+        "value": 10.0, "device": "cpu",
+    }))
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+
+    got = bench._last_tpu_record("resnet18_cifar10_b1024_train_throughput")
+    assert got is not None and got["value"] == 15298.6
+    assert got["source"].endswith("bench_resnet18.json")
+    assert "recorded" in got
+
+    # a bf16 run must NOT pick up the f32 record (and vice versa)
+    got_bf16 = bench._last_tpu_record(
+        "resnet18_cifar10_b1024_train_throughput_bf16"
+    )
+    assert got_bf16["value"] == 30000.0
+    # CPU-labeled files are never evidence
+    assert bench._last_tpu_record("nonexistent_metric") is None
+
+
+def test_peak_flops_unknown_kind_returns_none(bench):
+    class Dev:
+        device_kind = "TPU v9 hyper"
+
+    assert bench._peak_flops_per_sec(Dev()) is None
+
+    class V5e:
+        device_kind = "TPU v5 lite"
+
+    assert bench._peak_flops_per_sec(V5e()) == 197e12
+
+    class Cpu:
+        device_kind = "cpu"
+
+    assert bench._peak_flops_per_sec(Cpu()) is None
