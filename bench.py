@@ -51,6 +51,9 @@ WORKLOADS = {
     # the same reference normalization (apples-to-oranges, labeled as such).
     # The metric name is built from the actual (env-overridable) config.
     "lm": dict(metric=None),
+    # serving side of the same transformer: KV-cache autoregressive
+    # generation (models/decode.py), tokens/sec of NEW tokens
+    "decode": dict(metric=None),
 }
 
 
@@ -69,6 +72,70 @@ def _lm_env(name: str) -> int:
 _BENCH_DTYPES = ("float32", "bfloat16")
 _LM_DTYPE_DEFAULT = "bfloat16"  # MXU-native; CNNs default float32 (parity)
 _CNN_DTYPE_DEFAULT = "float32"
+
+
+_DEC_DEFAULTS = {"BATCH": 8, "PROMPT": 128, "NEW": 128, "DIM": 512,
+                 "DEPTH": 6}
+
+
+def _dec_env(name: str) -> int:
+    return int(os.environ.get(f"BENCH_DEC_{name}", _DEC_DEFAULTS[name]))
+
+
+def _dec_tag() -> str:
+    """Decode metric shape tag from the SAME BENCH_DEC_* envs the workload
+    reads (error records share the key — same contract as _lm_tag)."""
+    tag = (
+        f"d{_dec_env('DIM')}x{_dec_env('DEPTH')}"
+        f"_p{_dec_env('PROMPT')}_n{_dec_env('NEW')}_b{_dec_env('BATCH')}"
+    )
+    if os.environ.get("BENCH_DTYPE", _LM_DTYPE_DEFAULT) == "float32":
+        tag += "_f32"
+    return tag
+
+
+def _bench_decode(steps: int) -> tuple:
+    """KV-cache autoregressive generation throughput: NEW tokens/sec across
+    the batch (prefill included in the measured loop — it is part of
+    serving a request)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ps_pytorch_tpu.models.decode import make_generate
+    from ps_pytorch_tpu.models.transformer import (
+        TransformerConfig,
+        init_transformer,
+    )
+    from ps_pytorch_tpu.utils import host_sync
+
+    batch, t_prompt = _dec_env("BATCH"), _dec_env("PROMPT")
+    n_new = _dec_env("NEW")
+    _, dt = _bench_dtype(jnp, _LM_DTYPE_DEFAULT)
+    cfg = TransformerConfig(
+        vocab_size=2048,
+        dim=_dec_env("DIM"),
+        depth=_dec_env("DEPTH"),
+        heads=8,
+        max_seq_len=t_prompt + n_new,
+        compute_dtype=dt,
+    )
+    params = init_transformer(cfg, jax.random.key(0))
+    gen = make_generate(cfg, max_new_tokens=n_new)
+    prompt = jax.random.randint(
+        jax.random.key(1), (batch, t_prompt), 0, cfg.vocab_size, jnp.int32
+    )
+    # greedy decode (temperature=0): the key argument is unconsumed, so
+    # every timed call computes the identical output — what we're timing
+    # is the KV-cache scan, not sampling
+    key = jax.random.key(2)
+    out = gen(params, prompt, key)
+    host_sync(out)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        out = gen(params, prompt, key)
+    host_sync(out)
+    elapsed = time.perf_counter() - t0
+    return batch * n_new * steps / elapsed, elapsed, _dec_tag()
 
 
 def _bench_dtype(jnp, default: str):
@@ -268,6 +335,42 @@ def _validate_env() -> None:
             f"BENCH_WORKLOAD must be one of {sorted(WORKLOADS)}, "
             f"got {os.environ['BENCH_WORKLOAD']!r}"
         )
+    int_knobs = (
+        ["BENCH_STEPS"]
+        + [f"BENCH_LM_{k}" for k in _LM_DEFAULTS]
+        + [f"BENCH_DEC_{k}" for k in _DEC_DEFAULTS]
+    )
+    for knob in int_knobs:
+        val = os.environ.get(knob)
+        if val is not None:
+            try:
+                int(val)
+            except ValueError:
+                raise SystemExit(f"{knob} must be an integer, got {val!r}")
+
+
+def _success_metric() -> str:
+    """The metric key the CURRENT env's success record would carry (no
+    _cpu_fallback suffix) — the single source for error records and
+    banked-hardware-evidence lookups."""
+    name = os.environ.get("BENCH_WORKLOAD", "lenet")
+    if name == "lm":
+        return f"lm_{_lm_tag()}_train_tokens_per_sec"
+    if name == "decode":
+        return f"decode_{_dec_tag()}_new_tokens_per_sec"
+    metric = WORKLOADS.get(name, {}).get("metric") or f"{name}_train_throughput"
+    return metric + _cnn_dtype_suffix()
+
+
+def _attach_banked(rec: dict) -> None:
+    """On a fallback/error record, attach the banked hardware record for
+    the ORIGINALLY REQUESTED config: the fallback child runs shrunken
+    shapes, so the parent passes its own success-metric key down via
+    BENCH_PARENT_METRIC (else the lookup would chase the liveness shape
+    and never match)."""
+    key = os.environ.get("BENCH_PARENT_METRIC") or _success_metric()
+    if banked := _last_tpu_record(key):
+        rec["last_tpu_record"] = banked
 
 
 def main() -> None:
@@ -310,14 +413,33 @@ def main() -> None:
             "mfu": _mfu(flops, steps, elapsed, jax, n_devices=lm_dev),
             "device": device_kind,
         }
-        if fallback and (
-            banked := _last_tpu_record(f"lm_{shape_tag}_train_tokens_per_sec")
-        ):
-            rec["last_tpu_record"] = banked
+        if fallback:
+            _attach_banked(rec)
         print(json.dumps(rec))
         print(
             f"# 1 device (1x1 mesh), {elapsed:.2f}s for {steps} LM steps, "
             f"final loss {loss:.4f}",
+            file=sys.stderr,
+        )
+        return
+    if name == "decode":
+        steps = int(os.environ.get("BENCH_STEPS", 10))
+        tokens_per_sec, elapsed, shape_tag = _bench_decode(steps)
+        rec = {
+            "metric": f"decode_{shape_tag}_new_tokens_per_sec{suffix}",
+            "value": round(tokens_per_sec, 1),
+            "unit": "tokens/sec",
+            # generation has no reference counterpart at all; keep the
+            # field for schema stability, explicitly null
+            "vs_baseline": None,
+            "mfu": None,  # decode is KV-cache-bandwidth-bound by design
+            "device": device_kind,
+        }
+        if fallback:
+            _attach_banked(rec)
+        print(json.dumps(rec))
+        print(
+            f"# 1 device, {elapsed:.2f}s for {steps} generate calls",
             file=sys.stderr,
         )
         return
@@ -377,10 +499,8 @@ def main() -> None:
         "mfu": _mfu(flops, steps, elapsed, jax, n_devices=n_dev),
         "device": device_kind,
     }
-    if fallback and (
-        banked := _last_tpu_record(w["metric"] + _cnn_dtype_suffix())
-    ):
-        rec["last_tpu_record"] = banked
+    if fallback:
+        _attach_banked(rec)
     print(json.dumps(rec))
     print(
         f"# {n_dev} device(s), {elapsed:.2f}s for {steps} steps "
@@ -399,36 +519,36 @@ def _fallback_env() -> dict:
     env = clean_cpu_env(n_devices=1)
     env["BENCH_CPU_FALLBACK"] = "1"
     env["BENCH_STEPS"] = env.get("BENCH_STEPS", "5")
+    # the child's shrunken-shape metric never matches banked hardware
+    # records; hand it the ORIGINAL config's key for evidence lookup
+    env["BENCH_PARENT_METRIC"] = _success_metric()
     if os.environ.get("BENCH_WORKLOAD") == "lm":
         env.update(
             BENCH_LM_BATCH="2", BENCH_LM_SEQ="256", BENCH_LM_DIM="128",
             BENCH_LM_DEPTH="2", BENCH_LM_SP="1", BENCH_LM_FLASH="0",
+        )
+    elif os.environ.get("BENCH_WORKLOAD") == "decode":
+        env.update(
+            BENCH_DEC_BATCH="2", BENCH_DEC_PROMPT="16", BENCH_DEC_NEW="16",
+            BENCH_DEC_DIM="128", BENCH_DEC_DEPTH="2",
         )
     return env
 
 
 def _emit_error_record(err: str) -> None:
     name = os.environ.get("BENCH_WORKLOAD", "lenet")
-    if name == "lm":
-        # same tag construction as the success path => same metric key
-        metric = f"lm_{_lm_tag()}_train_tokens_per_sec"
-    else:
-        metric = (
-            WORKLOADS.get(name, {}).get("metric")
-            or f"{name}_train_throughput"
-        ) + _cnn_dtype_suffix()
-    success_metric = metric
+    # same construction as the success path => same metric key
+    metric = _success_metric()
     if os.environ.get("BENCH_CPU_FALLBACK") == "1":
         metric += "_cpu_fallback"  # keep error keys aligned with success keys
     rec = {
         "metric": metric,
         "value": None,
-        "unit": "tokens/sec" if name == "lm" else "images/sec",
+        "unit": "tokens/sec" if name in ("lm", "decode") else "images/sec",
         "vs_baseline": None,
         "error": err[:500],
     }
-    if banked := _last_tpu_record(success_metric):
-        rec["last_tpu_record"] = banked
+    _attach_banked(rec)
     print(json.dumps(rec))
 
 

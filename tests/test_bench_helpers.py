@@ -42,6 +42,16 @@ def test_lm_tag_encodes_overrides(bench, monkeypatch):
     assert bench._lm_tag().endswith("_f32")
 
 
+def test_dec_tag_encodes_overrides(bench, monkeypatch):
+    monkeypatch.delenv("BENCH_DTYPE", raising=False)
+    for var in ("BATCH", "PROMPT", "NEW", "DIM", "DEPTH"):
+        monkeypatch.delenv(f"BENCH_DEC_{var}", raising=False)
+    assert bench._dec_tag() == "d512x6_p128_n128_b8"
+    monkeypatch.setenv("BENCH_DEC_NEW", "256")
+    monkeypatch.setenv("BENCH_DTYPE", "float32")
+    assert bench._dec_tag() == "d512x6_p128_n256_b8_f32"
+
+
 def test_cnn_dtype_suffix_matches_contract(bench, monkeypatch):
     monkeypatch.delenv("BENCH_DTYPE", raising=False)
     assert bench._cnn_dtype_suffix() == ""
@@ -93,6 +103,57 @@ def test_last_tpu_record_matches_metric_exactly(bench, tmp_path, monkeypatch):
     assert got_bf16["value"] == 30000.0
     # CPU-labeled files are never evidence
     assert bench._last_tpu_record("nonexistent_metric") is None
+
+
+def test_success_metric_covers_all_workloads(bench, monkeypatch):
+    monkeypatch.delenv("BENCH_DTYPE", raising=False)
+    for var in list(bench._LM_DEFAULTS) + list(bench._DEC_DEFAULTS):
+        monkeypatch.delenv(f"BENCH_LM_{var}", raising=False)
+        monkeypatch.delenv(f"BENCH_DEC_{var}", raising=False)
+    cases = {
+        "lenet": "lenet_mnist_b8192_train_throughput",
+        "resnet18": "resnet18_cifar10_b1024_train_throughput",
+        "lm": "lm_d512x6_s1024_b8_train_tokens_per_sec",
+        "decode": "decode_d512x6_p128_n128_b8_new_tokens_per_sec",
+    }
+    for wl, want in cases.items():
+        monkeypatch.setenv("BENCH_WORKLOAD", wl)
+        assert bench._success_metric() == want
+
+
+def test_attach_banked_uses_parent_metric(bench, tmp_path, monkeypatch):
+    # the fallback child runs shrunken shapes; BENCH_PARENT_METRIC must
+    # win over the child env's own (mismatching) tag
+    rec_dir = tmp_path / "runs" / "tpu_r99"
+    rec_dir.mkdir(parents=True)
+    (rec_dir / "bench_lm_1k.json").write_text(json.dumps({
+        "metric": "lm_d512x6_s1024_b8_train_tokens_per_sec",
+        "value": 220555.7, "device": "TPU v5 lite",
+    }))
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    monkeypatch.setenv("BENCH_WORKLOAD", "lm")
+    monkeypatch.setenv("BENCH_LM_SEQ", "256")  # the child's liveness shape
+    monkeypatch.setenv(
+        "BENCH_PARENT_METRIC", "lm_d512x6_s1024_b8_train_tokens_per_sec"
+    )
+    rec = {}
+    bench._attach_banked(rec)
+    assert rec["last_tpu_record"]["value"] == 220555.7
+    # without the parent key, the shrunken tag matches nothing
+    monkeypatch.delenv("BENCH_PARENT_METRIC")
+    rec2 = {}
+    bench._attach_banked(rec2)
+    assert "last_tpu_record" not in rec2
+
+
+def test_validate_env_rejects_non_integer_knobs(bench, monkeypatch):
+    monkeypatch.delenv("BENCH_DTYPE", raising=False)
+    monkeypatch.setenv("BENCH_WORKLOAD", "decode")
+    monkeypatch.setenv("BENCH_DEC_NEW", "12b8")
+    with pytest.raises(SystemExit):
+        bench._validate_env()
+    monkeypatch.setenv("BENCH_DEC_NEW", "128")
+    bench._validate_env()
 
 
 def test_peak_flops_unknown_kind_returns_none(bench):

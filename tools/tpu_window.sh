@@ -84,6 +84,9 @@ timeout 580 python tools/overlap_report.py topology --workers 8 \
 #     like-for-like math vs the reference)
 bank_bench bench_resnet18_bf16 BENCH_WORKLOAD=resnet18 BENCH_DTYPE=bfloat16
 
+# 5c. serving-side record: KV-cache autoregressive generation
+bank_bench bench_decode BENCH_WORKLOAD=decode
+
 # 6. MFU scaling probe: larger LM configs (stated target: >=40% MFU on LM;
 #    d512x6 measured 22% — bigger matmuls should close the gap)
 bank_bench bench_lm_d1024x8_s2048 BENCH_WORKLOAD=lm BENCH_LM_DIM=1024 \
