@@ -1,0 +1,110 @@
+"""Convergence comparison across gradient-compression modes.
+
+The reference's canonical config always trains WITH compression
+(/root/reference/src/run_pytorch.sh:1-16: `--compress-grad` on), so parity
+evidence needs convergence curves per compression mode on the same data —
+round-2 VERDICT item 4. This merges the real-digits training/eval JSONLs
+(`--metrics-file` output of cli/train + the evaluator logs) into one table:
+per logged step, loss and Prec@1 for each mode side by side, plus a summary
+row (final train loss/Prec@1, best eval Prec@1, mean steady-state step
+time).
+
+  python -m analysis.compression_convergence \\
+      --run uncompressed=runs/real_digits/resnet18_train.jsonl \\
+      --run int8=runs/real_digits/resnet18_int8_train.jsonl \\
+      --run 2round_ef=runs/real_digits/resnet18_2round_ef_train.jsonl \\
+      [--out runs/real_digits/compression_convergence.json]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+
+
+def load_run(path: str) -> dict:
+    """{'train': [records], 'eval': [records]} from a --metrics-file JSONL."""
+    out = {"train": [], "eval": []}
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            out.setdefault(rec.get("kind", "train"), []).append(rec)
+    return out
+
+
+def summarize(run: dict) -> dict:
+    train, evals = run["train"], run["eval"]
+    if not train:
+        return {"error": "no train records"}
+    # steady-state step time: skip the first record (compile)
+    times = [r["time_cost"] for r in train[1:] if "time_cost" in r]
+    return {
+        "steps": train[-1]["step"],
+        "final_train_loss": round(train[-1]["loss"], 4),
+        "final_train_prec1": round(train[-1].get("prec1", float("nan")), 2),
+        "best_eval_prec1": (
+            round(max(r["prec1"] for r in evals), 2) if evals else None
+        ),
+        "final_eval_prec1": (
+            round(evals[-1]["prec1"], 2) if evals else None
+        ),
+        "mean_step_seconds": (
+            round(sum(times) / len(times), 2) if times else None
+        ),
+    }
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--run", action="append", required=True,
+                   metavar="NAME=PATH",
+                   help="label=path-to-metrics-jsonl (repeatable)")
+    p.add_argument("--out", default=None)
+    args = p.parse_args(argv)
+
+    runs = {}
+    for spec in args.run:
+        name, _, path = spec.partition("=")
+        if not path:
+            raise SystemExit(f"--run wants NAME=PATH, got {spec!r}")
+        runs[name] = load_run(path)
+
+    steps = sorted({r["step"] for run in runs.values() for r in run["train"]})
+    by_step = {
+        name: {r["step"]: r for r in run["train"]}
+        for name, run in runs.items()
+    }
+    table = []
+    for s in steps:
+        row = {"step": s}
+        for name in runs:
+            rec = by_step[name].get(s)
+            if rec:
+                row[f"{name}_loss"] = round(rec["loss"], 4)
+                row[f"{name}_prec1"] = round(rec.get("prec1", float("nan")), 2)
+        table.append(row)
+
+    report = {
+        "summary": {name: summarize(run) for name, run in runs.items()},
+        "per_step": table,
+    }
+    cols = ["step"] + [f"{n}_{k}" for n in runs for k in ("loss", "prec1")]
+    print("  ".join(f"{c:>18}" for c in cols))
+    for row in table:
+        print("  ".join(f"{row.get(c, ''):>18}" for c in cols))
+    print(json.dumps(report["summary"], indent=2))
+    if args.out:
+        if os.path.dirname(args.out):
+            os.makedirs(os.path.dirname(args.out), exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"report -> {args.out}")
+    return report
+
+
+if __name__ == "__main__":
+    main()
