@@ -42,10 +42,15 @@ def summarize(run: dict) -> dict:
         return {"error": "no train records"}
     # steady-state step time: skip the first record (compile)
     times = [r["time_cost"] for r in train[1:] if "time_cost" in r]
+    # missing prec1 -> None (NOT NaN: json.dump emits bare NaN, which is
+    # invalid strict JSON and breaks downstream parsers of --out)
+    final_prec1 = train[-1].get("prec1")
     return {
         "steps": train[-1]["step"],
         "final_train_loss": round(train[-1]["loss"], 4),
-        "final_train_prec1": round(train[-1].get("prec1", float("nan")), 2),
+        "final_train_prec1": (
+            round(final_prec1, 2) if final_prec1 is not None else None
+        ),
         "best_eval_prec1": (
             round(max(r["prec1"] for r in evals), 2) if evals else None
         ),
@@ -85,7 +90,8 @@ def main(argv=None) -> dict:
             rec = by_step[name].get(s)
             if rec:
                 row[f"{name}_loss"] = round(rec["loss"], 4)
-                row[f"{name}_prec1"] = round(rec.get("prec1", float("nan")), 2)
+                if rec.get("prec1") is not None:
+                    row[f"{name}_prec1"] = round(rec["prec1"], 2)
         table.append(row)
 
     report = {

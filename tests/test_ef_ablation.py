@@ -1,0 +1,110 @@
+"""Error feedback as a MEASURED convergence claim (round-3 VERDICT item 7).
+
+The EF-SGD argument in parallel/ps.py (PSConfig.error_feedback docstring)
+says aggressive compression needs error feedback to converge. This test
+turns that into data using the genuinely-distributed failure mode:
+
+Per-tensor int8 quantization rounds to the nearest of 255 levels spanning
+each WORKER's gradient range. With heterogeneous shards, each worker
+carries a large self-canceling gradient component (here: a feature whose
+sign flips between the two workers' data), so the per-worker quantization
+step is set by a component ~500x larger than the consensus signal. The
+informative gradients fall below half a quantization step and nearest
+rounding transmits EXACT ZEROS for them every step — without error
+feedback the model cannot learn at all (loss pinned near ln(10)); with EF
+the dropped residual accumulates until it crosses the threshold and the
+model converges.
+
+This is the standard EF-SGD phenomenon (Karimireddy et al. 2019, "Error
+Feedback Fixes SignSGD"), reproduced through the REAL PS train step — the
+same shard_map/collective path the trainer uses — not a simulation of the
+quantizer. The benign side is also pinned: on a homogeneous workload int8
+tracks exact closely with or without EF (consistent with the real-data
+convergence runs in runs/real_digits/).
+"""
+
+import flax.linen as nn
+import jax
+import numpy as np
+import pytest
+
+from ps_pytorch_tpu.optim import adam
+from ps_pytorch_tpu.parallel import (
+    PSConfig,
+    init_ps_state,
+    make_ps_train_step,
+    shard_batch,
+    shard_state,
+)
+
+N = 2  # heterogeneity is two-sided; a 2-worker submesh keeps the test fast
+C, D = 10, 12
+BIG, TINY = 500.0, 1.0
+
+
+class _ZeroLinear(nn.Module):
+    """Zero-initialized linear head: loss starts exactly at ln(C) and the
+    huge +/-BIG feature contributes nothing to the forward pass until its
+    (mean-zero) gradient moves it — keeps the dynamics stable."""
+
+    @nn.compact
+    def __call__(self, x, train=False):
+        return nn.Dense(C, kernel_init=nn.initializers.zeros)(x)
+
+
+def _hetero_batch(seed, per_worker=128):
+    """Disjoint heterogeneous shards in worker order (shard_batch splits
+    contiguously): feature 0 is +BIG on worker 0's data and -BIG on worker
+    1's; features 1..C are a TINY-amplitude one-hot of the label — the only
+    consensus signal."""
+    r = np.random.RandomState(seed)
+    xs, ys = [], []
+    for w in range(N):
+        y = r.randint(0, C, (per_worker,)).astype(np.int32)
+        info = TINY * np.eye(C)[y]
+        f0 = np.full((per_worker, 1), BIG if w == 0 else -BIG)
+        pad = np.zeros((per_worker, D - C - 1))
+        xs.append(np.concatenate([f0, info, pad], 1).astype(np.float32))
+        ys.append(y)
+    return {"image": np.concatenate(xs), "label": np.concatenate(ys)}
+
+
+def _final_loss(mesh2, error_feedback, compress="int8", steps=100):
+    cfg = PSConfig(num_workers=N, compress=compress,
+                   error_feedback=error_feedback, quant_rounding="nearest")
+    model = _ZeroLinear()
+    tx = adam(0.01)
+    state = init_ps_state(model, tx, cfg, jax.random.key(0), (D,))
+    state = shard_state(state, mesh2, cfg)
+    step = make_ps_train_step(model, tx, cfg, mesh2, donate=False)
+    batches = [shard_batch(_hetero_batch(s), mesh2, cfg) for s in range(4)]
+    loss = None
+    for i in range(steps):
+        state, m = step(state, batches[i % 4], jax.random.key(1))
+        loss = float(m["loss"])
+    return loss
+
+
+@pytest.fixture(scope="module")
+def mesh2():
+    from jax.sharding import Mesh
+
+    from ps_pytorch_tpu.parallel.mesh import WORKER_AXIS
+
+    return Mesh(np.array(jax.devices()[:N]), (WORKER_AXIS,))
+
+
+def test_error_feedback_rescues_subthreshold_signal(mesh2):
+    """At 500x gradient heterogeneity, nearest-int8 without EF transmits
+    zeros for every informative coordinate -> no learning; EF pushes the
+    accumulated signal through. Calibrated margins: measured 2.19 (no EF)
+    vs 1.84 (EF) vs 0.94 (exact) at step 100."""
+    no_ef = _final_loss(mesh2, error_feedback=False)
+    with_ef = _final_loss(mesh2, error_feedback=True)
+    exact = _final_loss(mesh2, error_feedback=False, compress=None)
+    # without EF the model is pinned near chance (ln 10 ~ 2.303)
+    assert no_ef > 2.0, no_ef
+    # with EF it is clearly learning, and the gap is decisive
+    assert with_ef < no_ef - 0.25, (with_ef, no_ef)
+    # sanity: uncompressed learns fastest of all
+    assert exact < with_ef, (exact, with_ef)

@@ -1,0 +1,235 @@
+"""Predicted multi-chip scaling from AOT-partitioned HLO (no hardware).
+
+The reference's headline artifact is a measured speedup table at
+1/2/4/8/16/32 workers (analysis/Speedup_Comparisons_LeNet.ipynb cell 6,
+BASELINE.md). Real multi-chip is unavailable in this environment, so this
+tool produces the committed stand-in round-3 VERDICT asked for (missing #3):
+for each (worker count, compression mode) it partitions the REAL PS train
+step for an N-device mesh, reads the collective operations XLA actually
+emitted — kind, count, and exact on-wire payload bytes — and folds them
+through a standard, clearly-labeled alpha-beta ring model to predict
+per-step collective cost and scaling efficiency on v5e ICI.
+
+What is measured vs modeled:
+  measured  collective kinds/counts/payload bytes, from the compiled
+            SPMD program (the same `analyze_hlo_schedule` used by
+            overlap_report.py). Gradient payloads do not depend on batch
+            size, so the tiny per-worker batch used here changes nothing.
+  modeled   link time per collective: ring all-reduce 2(n-1)/n * S / BW,
+            all-gather / reduce-scatter / all-to-all (n-1)/n * S / BW,
+            collective-permute S / BW, with BW = --ici-gbs (default 45
+            GB/s, the public one-way per-ICI-link figure for v5e).
+            Compute time at n workers = t1 / n (fixed global batch, the
+            reference's own normalization), t1 from the banked TPU
+            ResNet18 b=1024 record when present (--t1 overrides).
+
+Efficiency bounds: "no overlap" serializes compute + comm; "full overlap"
+takes max(compute, comm) — the XLA latency-hiding scheduler lands between
+them (component #12 evidence: tools/overlap_report.py).
+
+The partitioner runs on the CPU backend here. Payload sizes and collective
+choices come from SPMD partitioning, which is backend-independent; the
+*schedule* is not, so this tool reports bytes/counts only and leaves
+schedule claims to overlap_report.py.
+
+Usage:
+  python tools/predicted_scaling.py --out runs/predicted_scaling.json
+  python tools/predicted_scaling.py --workers 8 16 32 --modes none int8
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+# mode name -> PSConfig knobs. "hier" is the hierarchical DCN x ICI
+# composition (ps.py dcn_hosts>1): ICI reduce-scatter -> one int8 DCN
+# crossing -> ICI all-gather; hosts chosen so each host holds 8 chips
+# (a v5e host), min 2 hosts.
+MODES = {
+    "none": dict(compress=None),
+    "int8": dict(compress="int8"),
+    "int8_2round": dict(compress="int8_2round"),
+    "hier_2round": dict(compress="int8_2round", hier=True),
+}
+
+# ring/torus step-count factors per collective kind (alpha-beta model,
+# bytes multiplier applied to the payload): all-reduce moves every byte
+# twice minus the 1/n it keeps; one-shot redistributions move (n-1)/n.
+_RING_FACTOR = {
+    "all-reduce": lambda n: 2 * (n - 1) / n,
+    "all-gather": lambda n: (n - 1) / n,
+    "reduce-scatter": lambda n: (n - 1) / n,
+    "all-to-all": lambda n: (n - 1) / n,
+    "collective-permute": lambda n: 1.0,
+}
+
+
+def child(args) -> None:
+    """Partition the PS step for the CURRENT process's device count and
+    emit one JSON line of collective stats (spawned by main with
+    XLA_FLAGS=--xla_force_host_platform_device_count=N)."""
+    import jax
+
+    from ps_pytorch_tpu.parallel.mesh import make_hybrid_mesh, make_mesh
+    from tools.overlap_report import analyze_hlo_schedule, _build_step
+
+    n = args.one_workers
+    mode = MODES[args.one_mode]
+    hosts = max(2, n // 8) if mode.get("hier") else 1
+    dataset = "MNIST" if args.network == "LeNet" else "Cifar10"
+    ns = argparse.Namespace(
+        workers=n, network=args.network, dataset=dataset,
+        batch=args.batch * n, compress=mode["compress"],
+        num_aggregate=None,
+    )
+    if hosts > 1:
+        mesh = make_hybrid_mesh(hosts, n // hosts)
+    else:
+        mesh = make_mesh(num_workers=n)
+    step, state, batch = _build_step(ns, mesh, dcn_hosts=hosts)
+
+    txt = step.lower(state, batch, jax.random.key(1)).compile().as_text()
+    rep = analyze_hlo_schedule(txt)
+    by_kind: dict = {}
+    for c in rep["collectives"]:
+        k = by_kind.setdefault(c["kind"], {"count": 0, "bytes": 0})
+        k["count"] += 1
+        k["bytes"] += c["bytes"]
+    print(json.dumps({
+        "workers": n, "mode": args.one_mode, "hosts": hosts,
+        "by_kind": by_kind,
+        "total_collective_bytes": sum(k["bytes"] for k in by_kind.values()),
+        "n_collectives": sum(k["count"] for k in by_kind.values()),
+    }))
+
+
+def _banked_t1() -> tuple[float | None, str | None]:
+    """Per-step seconds of the banked single-chip TPU ResNet18 b=1024 f32
+    record (the t_compute anchor), or (None, None). Reuses bench.py's
+    newest-matching-record lookup so both tools agree on which banked
+    record is 'the' evidence for a metric key."""
+    import bench
+
+    rec = bench._last_tpu_record("resnet18_cifar10_b1024_train_throughput")
+    if rec is None or not rec.get("value"):
+        return None, None
+    return 1024.0 / rec["value"], rec.get("source")
+
+
+def predict(row: dict, t1: float, bw: float) -> dict:
+    """Fold one child measurement through the alpha-beta model."""
+    n = row["workers"]
+    comm = 0.0
+    for kind, st in row["by_kind"].items():
+        factor = _RING_FACTOR.get(kind, lambda n: 2 * (n - 1) / n)(n)
+        comm += st["bytes"] * factor / bw
+    compute = t1 / n
+    return {
+        **row,
+        "modeled_comm_s": round(comm, 6),
+        "modeled_compute_s": round(compute, 6),
+        "speedup_no_overlap": round(t1 / (compute + comm), 2),
+        "speedup_full_overlap": round(t1 / max(compute, comm), 2),
+        "efficiency_no_overlap": round(t1 / (compute + comm) / n, 4),
+        "efficiency_full_overlap": round(t1 / max(compute, comm) / n, 4),
+    }
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--workers", type=int, nargs="+", default=[8, 16, 32])
+    p.add_argument("--modes", nargs="+", default=list(MODES),
+                   choices=list(MODES))
+    p.add_argument("--network", default="ResNet18")
+    p.add_argument("--batch", type=int, default=8,
+                   help="per-worker batch (payloads are batch-independent)")
+    p.add_argument("--ici-gbs", type=float, default=45.0,
+                   help="one-way per-link ICI GB/s (public v5e figure)")
+    p.add_argument("--t1", type=float, default=None,
+                   help="single-chip step seconds; default: banked TPU record")
+    p.add_argument("--timeout", type=int, default=900)
+    p.add_argument("--out", default=None)
+    p.add_argument("--one-workers", type=int, default=None,
+                   help=argparse.SUPPRESS)  # child mode
+    p.add_argument("--one-mode", default=None, help=argparse.SUPPRESS)
+    args = p.parse_args(argv)
+
+    if args.one_workers:
+        child(args)
+        return {}
+
+    from tpu_env import clean_cpu_env
+
+    t1, t1_src = (args.t1, "--t1") if args.t1 else _banked_t1()
+    if t1 is None:
+        t1, t1_src = 0.067, "fallback (no banked record): 15.3k img/s r03"
+    bw = args.ici_gbs * 1e9
+
+    rows, failures = [], []
+    for n in args.workers:
+        for mode in args.modes:
+            if MODES[mode].get("hier") and n < 16:
+                continue  # hier needs >=2 hosts of >=8 chips
+            cmd = [sys.executable, os.path.abspath(__file__),
+                   "--one-workers", str(n), "--one-mode", mode,
+                   "--network", args.network, "--batch", str(args.batch)]
+            try:
+                proc = subprocess.run(
+                    cmd, env=clean_cpu_env(n_devices=n), cwd=REPO,
+                    capture_output=True, text=True, timeout=args.timeout,
+                )
+            except subprocess.TimeoutExpired:
+                failures.append({"workers": n, "mode": mode,
+                                 "error": f"timeout {args.timeout}s"})
+                continue
+            if proc.returncode != 0:
+                failures.append({"workers": n, "mode": mode,
+                                 "error": proc.stderr.strip()[-500:]})
+                continue
+            row = json.loads(proc.stdout.strip().splitlines()[-1])
+            rows.append(predict(row, t1, bw))
+            print(f"# {n} workers / {mode}: "
+                  f"{row['total_collective_bytes']/1e6:.2f} MB wire, "
+                  f"{rows[-1]['speedup_no_overlap']}x-"
+                  f"{rows[-1]['speedup_full_overlap']}x", file=sys.stderr)
+
+    report = {
+        "model": {
+            "t1_seconds": t1, "t1_source": t1_src,
+            "ici_gbs_one_way": args.ici_gbs,
+            "factors": "all-reduce 2(n-1)/n; gather/scatter/a2a (n-1)/n",
+            "caveat": (
+                "bytes/counts measured from the SPMD-partitioned HLO; "
+                "link time is an alpha-beta MODEL, not a measurement"
+            ),
+        },
+        "rows": rows,
+        "failures": failures,
+    }
+    hdr = (f"{'n':>4} {'mode':>12} {'wire MB':>9} {'colls':>6} "
+           f"{'comm ms':>9} {'eff (no ov)':>11} {'eff (full ov)':>13}")
+    print(hdr)
+    for r in rows:
+        print(f"{r['workers']:>4} {r['mode']:>12} "
+              f"{r['total_collective_bytes']/1e6:>9.2f} "
+              f"{r['n_collectives']:>6} {r['modeled_comm_s']*1e3:>9.3f} "
+              f"{r['efficiency_no_overlap']:>11.3f} "
+              f"{r['efficiency_full_overlap']:>13.3f}")
+    if args.out:
+        if os.path.dirname(args.out):
+            os.makedirs(os.path.dirname(args.out), exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(report, f, indent=2)
+        print(f"report -> {args.out}", file=sys.stderr)
+    return report
+
+
+if __name__ == "__main__":
+    main()

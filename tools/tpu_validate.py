@@ -167,9 +167,16 @@ def bench_flash(seq_lens, dtype_name, quick):
                 float(np.max(np.abs(_get(a) - _get(b_))))
                 for a, b_ in zip(gf(q, k, v), gn(q, k, v))
             )
-            parity_mode = "highest" if highest_ok else (
-                "exact" if on_cpu else "default"
-            )
+            # the exact/highest/default ladder only describes f32 rows:
+            # bf16 fwd error (~8e-3) is storage-precision noise gated by
+            # BF16_BOUND regardless of backend, so labeling a CPU bf16 row
+            # "exact" would overstate what was checked
+            if dtype_name == "bfloat16":
+                parity_mode = "bf16-default"
+            else:
+                parity_mode = "highest" if highest_ok else (
+                    "exact" if on_cpu else "default"
+                )
         else:
             fwd_err = fwd_err_default_oracle = fwd_err_highest = None
             bwd_err = None
