@@ -1,0 +1,111 @@
+"""Unit tests for the predicted-scaling model math and bench chaining
+helpers (no compiles — the compile-level paths are smoked by the tools
+themselves and the bench workloads)."""
+
+import importlib.util
+import json
+import os
+import sys
+
+import pytest
+
+REPO = os.path.join(os.path.dirname(__file__), "..")
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+@pytest.fixture(scope="module")
+def ps_mod():
+    spec = importlib.util.spec_from_file_location(
+        "predicted_scaling_under_test",
+        os.path.join(REPO, "tools", "predicted_scaling.py"),
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_ring_factors(ps_mod):
+    f = ps_mod._RING_FACTOR
+    # ring all-reduce moves every byte twice minus the kept 1/n share
+    assert f["all-reduce"](2) == pytest.approx(1.0)
+    assert f["all-reduce"](8) == pytest.approx(2 * 7 / 8)
+    assert f["all-gather"](8) == pytest.approx(7 / 8)
+    assert f["collective-permute"](8) == 1.0
+
+
+def test_predict_efficiency_bounds(ps_mod):
+    row = {
+        "workers": 8,
+        "by_kind": {"all-reduce": {"count": 1, "bytes": 44_700_000}},
+        "total_collective_bytes": 44_700_000,
+        "n_collectives": 1,
+        "mode": "none",
+        "hosts": 1,
+    }
+    t1, bw = 0.067, 45e9
+    out = ps_mod.predict(row, t1, bw)
+    comm = 44_700_000 * (2 * 7 / 8) / bw
+    assert out["modeled_comm_s"] == pytest.approx(comm, abs=1e-6)
+    assert out["modeled_compute_s"] == pytest.approx(t1 / 8, abs=1e-6)
+    # no-overlap is always the weaker bound
+    assert out["efficiency_no_overlap"] <= out["efficiency_full_overlap"]
+    assert out["speedup_no_overlap"] == pytest.approx(
+        t1 / (t1 / 8 + comm), rel=1e-2
+    )
+    # full-overlap cannot exceed linear
+    assert out["speedup_full_overlap"] <= 8.0 + 1e-6
+
+
+def test_unknown_collective_kind_uses_conservative_factor(ps_mod):
+    row = {
+        "workers": 4,
+        "by_kind": {"mystery-op": {"count": 1, "bytes": 1_000_000}},
+        "total_collective_bytes": 1_000_000,
+        "n_collectives": 1,
+        "mode": "none",
+        "hosts": 1,
+    }
+    out = ps_mod.predict(row, 0.1, 1e9)
+    # falls back to the all-reduce factor (the most expensive ring cost)
+    assert out["modeled_comm_s"] == pytest.approx(
+        1_000_000 * (2 * 3 / 4) / 1e9, abs=1e-9
+    )
+
+
+@pytest.fixture()
+def bench(monkeypatch):
+    spec = importlib.util.spec_from_file_location(
+        "bench_chain_under_test", os.path.join(REPO, "bench.py")
+    )
+    mod = importlib.util.module_from_spec(spec)
+    spec.loader.exec_module(mod)
+    return mod
+
+
+def test_chain_default_and_override(bench, monkeypatch):
+    monkeypatch.delenv("BENCH_CHAIN", raising=False)
+    assert bench._chain() == 1
+    monkeypatch.setenv("BENCH_CHAIN", "10")
+    assert bench._chain() == 10
+    monkeypatch.setenv("BENCH_CHAIN", "0")  # floor at 1: never a 0-iter loop
+    assert bench._chain() == 1
+
+
+def test_last_tpu_record_prefers_embedded_timestamp(bench, tmp_path, monkeypatch):
+    d = tmp_path / "runs" / "tpu_r98"
+    d.mkdir(parents=True)
+    # older embedded timestamp but newer mtime (the fresh-clone hazard) vs
+    # newer embedded timestamp: the embedded field must win
+    (d / "bench_a.json").write_text(json.dumps({
+        "metric": "m", "value": 1.0, "device": "TPU v5 lite",
+        "timestamp": "2026-01-01T00:00:00Z",
+    }))
+    (d / "bench_b.json").write_text(json.dumps({
+        "metric": "m", "value": 2.0, "device": "TPU v5 lite",
+        "timestamp": "2026-06-01T00:00:00Z",
+    }))
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    rec = bench._last_tpu_record("m")
+    assert rec["value"] == 2.0
+    assert rec["recorded"] == "2026-06-01T00:00:00Z"
+    assert rec["source"].endswith("bench_b.json")
