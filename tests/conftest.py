@@ -21,6 +21,60 @@ if "xla_force_host_platform_device_count" not in _flags:
 import jax  # noqa: E402
 import pytest  # noqa: E402
 
+# Measured-duration tier list (round-4 `--durations=40` on the 1-core CI
+# host): every test function here took >=6.8s there, together ~60% of
+# suite wall-clock. The collection hook below marks them `slow` so
+#   -m "not slow and not multihost"
+# is a fast core tier (~5-7 min on the 1-core host, minutes less on any
+# multi-core machine) while the full suite stays the default. Regenerate
+# with `pytest --durations=60` after big suite changes; parametrized
+# variants inherit the function-level mark.
+_SLOW_TESTS = {
+    "test_dryrun_multichip",
+    "test_remat_resnet_via_trainer",
+    "test_evaluator_handles_local_bn_checkpoints",
+    "test_greedy_matches_full_forward",
+    "test_transformer_mixed_precision_compute_dtype",
+    "test_moe_greedy_matches_full_forward",
+    "test_local_bn_mode_keeps_per_worker_stats",
+    "test_entry_compiles",
+    "test_transformer_flash_matches_naive",
+    "test_pp_moe_one_step_matches_dense_oracle",
+    "test_remat_transformer_matches_and_trains",
+    "test_dp_sp_matches_single_device",
+    "test_moe_remat_matches_and_bf16_stays_bf16",
+    "test_3d_one_step_matches_dense_oracle",
+    "test_ep_sp_one_step_matches_dense_oracle",
+    "test_dp_tp_one_step_matches_single_device",
+    "test_hierarchical_2round_over_dcn",
+    "test_scaling_bench_two_points",
+    "test_tp_grads_match_single_device",
+    "test_pp_moe_aux_is_load_balance_signal",
+    "test_sp_transformer_flash_remat_matches",
+    "test_cli_train_lm_parallelism_modes",
+    "test_greedy_on_trained_lm_continues_the_chain",
+    "test_ep_sp_bf16_remat_trains",
+    "test_dp_step_matches_single_device",
+    "test_flash_prefill_matches_naive",
+    "test_pp_moe_bf16_remat_trains",
+    "test_cli_train_lm_checkpoint_evaluate_round_trip",
+    "test_ep_sp_forward_matches_dense_oracle",
+    "test_dp_sp_trains",
+    "test_pp_loss_matches_single_device",
+    "test_flash_odd_seq_keeps_mxu_blocks",
+    "test_sp_transformer_trains",
+    "test_pp_moe_training_decreases_loss",
+    "test_sp_transformer_flash_trains",
+    "test_ring_flash_odd_shard_len_pads_not_degrades",
+    "test_ep_forward_matches_local_oracle",
+}
+
+
+def pytest_collection_modifyitems(config, items):
+    for item in items:
+        if item.originalname in _SLOW_TESTS or item.name in _SLOW_TESTS:
+            item.add_marker(pytest.mark.slow)
+
 
 @pytest.fixture(scope="session")
 def devices():
