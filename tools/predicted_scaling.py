@@ -209,6 +209,13 @@ def main(argv=None) -> dict:
                 "bytes/counts measured from the SPMD-partitioned HLO; "
                 "link time is an alpha-beta MODEL, not a measurement"
             ),
+            "hier_note": (
+                "hier_2round totals count its extra ICI staging bytes at "
+                "the same 45 GB/s as everything else; the design exists "
+                "for DCN-limited pods where the ONE int8 DCN crossing "
+                "per element dominates — this single-bandwidth table "
+                "understates it there"
+            ),
         },
         "rows": rows,
         "failures": failures,
