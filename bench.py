@@ -218,6 +218,25 @@ def _cnn_dtype_suffix() -> str:
     return ""
 
 
+# BENCH_COMPRESS overrides a CNN workload's gradient-compression mode
+# (default: the workload's canonical mode — int8 for resnet18, none for
+# lenet). Overridden records get a distinct metric key so they can never
+# shadow the canonical banked evidence.
+_COMPRESS_VALUES = ("none", "int8", "int8_2round")
+
+
+def _cnn_compress(default):
+    val = os.environ.get("BENCH_COMPRESS")
+    if val is None:
+        return default, ""
+    mode = None if val == "none" else val
+    if mode == default:
+        return default, ""  # explicit request for the canonical mode
+    tag = {"none": "_nocomp", "int8": "_int8w",
+           "int8_2round": "_2round"}[val]
+    return mode, tag
+
+
 def _bench_lm(steps: int) -> tuple:
     import jax
     import jax.numpy as jnp
@@ -396,6 +415,17 @@ def _validate_env() -> None:
             f"BENCH_DTYPE must be one of {list(_BENCH_DTYPES)}, "
             f"got {os.environ['BENCH_DTYPE']!r}"
         )
+    if os.environ.get("BENCH_COMPRESS") is not None:
+        if os.environ["BENCH_COMPRESS"] not in _COMPRESS_VALUES:
+            raise SystemExit(
+                f"BENCH_COMPRESS must be one of {list(_COMPRESS_VALUES)}, "
+                f"got {os.environ['BENCH_COMPRESS']!r}"
+            )
+        if os.environ.get("BENCH_WORKLOAD", "lenet") in ("lm", "decode"):
+            raise SystemExit(
+                "BENCH_COMPRESS only applies to the CNN (PS) workloads; "
+                "it would be silently ignored for lm/decode"
+            )
     if os.environ.get("BENCH_WORKLOAD", "lenet") not in WORKLOADS:
         raise SystemExit(
             f"BENCH_WORKLOAD must be one of {sorted(WORKLOADS)}, "
@@ -425,7 +455,8 @@ def _success_metric() -> str:
     if name == "decode":
         return f"decode_{_dec_tag()}_new_tokens_per_sec"
     metric = WORKLOADS.get(name, {}).get("metric") or f"{name}_train_throughput"
-    return metric + _cnn_dtype_suffix()
+    _, ctag = _cnn_compress(WORKLOADS.get(name, {}).get("compress"))
+    return metric + ctag + _cnn_dtype_suffix()
 
 
 def _attach_banked(rec: dict) -> None:
@@ -478,8 +509,9 @@ def main() -> None:
         (tokens_per_sec, loss, elapsed, shape_tag, flops, lm_dev,
          steps) = _bench_lm(steps)
         assert np.isfinite(loss), f"non-finite loss {loss}"
+        del shape_tag  # key comes from _success_metric, the single source
         rec = {
-            "metric": f"lm_{shape_tag}_train_tokens_per_sec{suffix}",
+            "metric": _success_metric() + suffix,
             "value": round(tokens_per_sec, 1),
             "unit": "tokens/sec",
             "vs_baseline": round(tokens_per_sec / REF_IMAGES_PER_SEC, 2),
@@ -501,8 +533,9 @@ def main() -> None:
     if name == "decode":
         steps = int(os.environ.get("BENCH_STEPS", 10))
         tokens_per_sec, elapsed, shape_tag = _bench_decode(steps)
+        del shape_tag  # key comes from _success_metric, the single source
         rec = {
-            "metric": f"decode_{shape_tag}_new_tokens_per_sec{suffix}",
+            "metric": _success_metric() + suffix,
             "value": round(tokens_per_sec, 1),
             "unit": "tokens/sec",
             # generation has no reference counterpart at all; keep the
@@ -521,7 +554,8 @@ def main() -> None:
         )
         return
     mesh = make_mesh(num_workers=n_dev)
-    cfg = PSConfig(num_workers=n_dev, compress=w["compress"])
+    compress, _ = _cnn_compress(w["compress"])
+    cfg = PSConfig(num_workers=n_dev, compress=compress)
     # BENCH_DTYPE=bfloat16 reports the MXU-native mixed-precision config
     # (params stay f32, same as the trainer's --dtype flag); the default
     # stays f32 for like-for-like comparison with the reference's math
@@ -577,7 +611,7 @@ def main() -> None:
     images_per_sec = steps * w["batch"] / elapsed
     assert np.isfinite(loss), f"non-finite loss {loss}"
     rec = {
-        "metric": w["metric"] + _cnn_dtype_suffix() + suffix,
+        "metric": _success_metric() + suffix,
         "value": round(images_per_sec, 1),
         "unit": "images/sec",
         "vs_baseline": round(images_per_sec / REF_IMAGES_PER_SEC, 2),

@@ -52,6 +52,28 @@ def test_dec_tag_encodes_overrides(bench, monkeypatch):
     assert bench._dec_tag() == "d512x6_p128_n256_b8_f32"
 
 
+def test_cnn_compress_override_tags_metric(bench, monkeypatch):
+    monkeypatch.delenv("BENCH_COMPRESS", raising=False)
+    monkeypatch.delenv("BENCH_DTYPE", raising=False)
+    monkeypatch.setenv("BENCH_WORKLOAD", "resnet18")
+    base = bench._success_metric()
+    assert base == "resnet18_cifar10_b1024_train_throughput"
+    # canonical mode requested explicitly -> canonical key (never forks
+    # the banked evidence)
+    monkeypatch.setenv("BENCH_COMPRESS", "int8")
+    assert bench._success_metric() == base
+    monkeypatch.setenv("BENCH_COMPRESS", "int8_2round")
+    assert bench._success_metric() == base + "_2round"
+    monkeypatch.setenv("BENCH_COMPRESS", "none")
+    assert bench._success_metric() == base + "_nocomp"
+    # compress tag composes with the dtype tag
+    monkeypatch.setenv("BENCH_DTYPE", "bfloat16")
+    assert bench._success_metric() == base + "_nocomp_bf16"
+    monkeypatch.setenv("BENCH_COMPRESS", "blosc")
+    with pytest.raises(SystemExit):
+        bench._validate_env()
+
+
 def test_cnn_dtype_suffix_matches_contract(bench, monkeypatch):
     monkeypatch.delenv("BENCH_DTYPE", raising=False)
     assert bench._cnn_dtype_suffix() == ""

@@ -91,6 +91,13 @@ timeout 580 python tools/overlap_report.py topology --workers 8 \
 bank_bench bench_resnet18_bf16 BENCH_WORKLOAD=resnet18 BENCH_DTYPE=bfloat16 \
   BENCH_CHAIN=10
 
+# 5a2. the true-int8-wire mode (the predicted-scaling artifact's winning
+#      config) and the uncompressed baseline, same canonical workload
+bank_bench bench_resnet18_2round BENCH_WORKLOAD=resnet18 \
+  BENCH_COMPRESS=int8_2round BENCH_CHAIN=10
+bank_bench bench_resnet18_nocomp BENCH_WORKLOAD=resnet18 \
+  BENCH_COMPRESS=none BENCH_CHAIN=10
+
 # 5c. serving-side record: KV-cache autoregressive generation
 bank_bench bench_decode BENCH_WORKLOAD=decode
 
