@@ -118,3 +118,40 @@ ENTRY %main () -> f32[4] {
 
 def test_analyze_schedule_no_entry():
     assert "error" in orp.analyze_hlo_schedule("HloModule empty")
+
+
+def _write_trace(tmp_path, events):
+    import gzip
+    import json
+
+    p = tmp_path / "plugins" / "profile" / "run1"
+    p.mkdir(parents=True)
+    with gzip.open(p / "host.trace.json.gz", "wt") as f:
+        json.dump({"traceEvents": events}, f)
+    return tmp_path
+
+
+def test_run_trace_excludes_infra_events_from_compute(tmp_path):
+    """Only real op events count as overlapped compute (ADVICE r03): an
+    infra span (barrier) fully covering the collective must not inflate
+    overlap_fraction; the name breakdowns make the classification
+    auditable."""
+    import argparse
+
+    meta = {"ph": "M", "name": "process_name", "pid": 7,
+            "args": {"name": "/device:TPU:0"}}
+    coll = {"ph": "X", "pid": 7, "name": "all-reduce.1", "ts": 100, "dur": 100}
+    # fusion overlaps the back half of the collective only
+    comp = {"ph": "X", "pid": 7, "name": "fusion.42", "ts": 150, "dur": 100}
+    # infra event spans the WHOLE collective; counting it would make
+    # overlap_fraction 1.0
+    infra = {"ph": "X", "pid": 7, "name": "barrier-wait", "ts": 90, "dur": 200}
+    _write_trace(tmp_path, [meta, coll, comp, infra])
+
+    rep = orp.run_trace(argparse.Namespace(profile_dir=str(tmp_path)))
+    assert rep["n_collective_events"] == 1
+    assert rep["n_compute_events"] == 1
+    assert rep["n_skipped_events"] == 1
+    assert rep["overlap_fraction"] == 0.5  # fusion half, not barrier whole
+    assert [e["name"] for e in rep["top_compute_events"]] == ["fusion.42"]
+    assert [e["name"] for e in rep["top_skipped_events"]] == ["barrier-wait"]
