@@ -179,7 +179,7 @@ def _bench_decode(steps: int) -> tuple:
         prompt = prompt.at[:, 0].set(out[:, -1] % cfg.vocab_size)
     host_sync(out, prompt)
     elapsed = time.perf_counter() - t0
-    return batch * n_new * steps / elapsed, elapsed, _dec_tag()
+    return batch * n_new * steps / elapsed, elapsed
 
 
 def _bench_dtype(jnp, default: str):
@@ -286,7 +286,10 @@ def _bench_lm(steps: int) -> tuple:
         params, opt, loss = step(params, opt, tok)
     host_sync(params, loss)
     flops = _step_flops(step, params, opt, tok)
-    k = _chain()
+    # never exceed the requested budget: BENCH_STEPS trims smoke runs on
+    # timeout-bounded windows, so a 10-deep default chain must shrink to
+    # the request rather than 4x it (non-multiples floor to outer*k)
+    k = min(_chain(), steps)
     if k > 1:
         carry, elapsed, steps = _timed_chain(
             lambda c: step(c[0], c[1], tok), (params, opt, loss),
@@ -299,7 +302,8 @@ def _bench_lm(steps: int) -> tuple:
             params, opt, loss = step(params, opt, tok)
         host_sync(params, loss)
         elapsed = time.perf_counter() - t0
-    return batch * seq * steps / elapsed, float(loss), elapsed, _lm_tag(), flops, n_sp, steps
+    return (batch * seq * steps / elapsed, float(loss), elapsed, flops,
+            n_sp, steps, k)
 
 
 # Peak dense matmul FLOP/s per chip keyed by exact (generation, variant)
@@ -390,17 +394,21 @@ def _last_tpu_record(expected_metric: str):
             # prefer the embedded measurement timestamp (written by every
             # success record since r04) — file mtime resets to checkout
             # time on a fresh clone, which would mis-date the evidence and
-            # make the newest-record tiebreak arbitrary
+            # make the newest-record tiebreak arbitrary. Records WITHOUT
+            # the field rank strictly below timestamped ones: their
+            # mtime-derived date would read as "checkout time = now" on a
+            # fresh clone and wrongly outrank genuinely newer evidence.
             when = rec.get("timestamp") or datetime.datetime.fromtimestamp(
                 os.path.getmtime(path), datetime.timezone.utc
             ).strftime("%Y-%m-%dT%H:%M:%SZ")
-            if best is None or when > best[0]:
-                best = (when, rec, path)
+            rank = ("timestamp" in rec, when)
+            if best is None or rank > best[0]:
+                best = (rank, rec, path, when)
         except (OSError, ValueError):
             continue
     if best is None:
         return None
-    when, rec, path = best
+    _, rec, path, when = best
     rec = dict(rec)
     rec["recorded"] = when
     rec["source"] = os.path.relpath(path, here)
@@ -506,10 +514,9 @@ def main() -> None:
         os.environ["BENCH_CHAIN"] = "10"
     if name == "lm":
         steps = int(os.environ.get("BENCH_STEPS", 20))
-        (tokens_per_sec, loss, elapsed, shape_tag, flops, lm_dev,
-         steps) = _bench_lm(steps)
+        (tokens_per_sec, loss, elapsed, flops, lm_dev, steps,
+         chain_used) = _bench_lm(steps)
         assert np.isfinite(loss), f"non-finite loss {loss}"
-        del shape_tag  # key comes from _success_metric, the single source
         rec = {
             "metric": _success_metric() + suffix,
             "value": round(tokens_per_sec, 1),
@@ -519,8 +526,8 @@ def main() -> None:
             "device": device_kind,
             "timestamp": _utc_now(),
         }
-        if _chain() > 1:
-            rec["chain"] = _chain()
+        if chain_used > 1:  # the EFFECTIVE depth (clamped to BENCH_STEPS)
+            rec["chain"] = chain_used
         if fallback:
             _attach_banked(rec)
         print(json.dumps(rec))
@@ -532,8 +539,7 @@ def main() -> None:
         return
     if name == "decode":
         steps = int(os.environ.get("BENCH_STEPS", 10))
-        tokens_per_sec, elapsed, shape_tag = _bench_decode(steps)
-        del shape_tag  # key comes from _success_metric, the single source
+        tokens_per_sec, elapsed = _bench_decode(steps)
         rec = {
             "metric": _success_metric() + suffix,
             "value": round(tokens_per_sec, 1),
@@ -591,7 +597,7 @@ def main() -> None:
     # BENCH_STEPS trims the measured window for smoke runs on slow hosts;
     # throughput extrapolates, the baseline comparison stays per-image.
     steps = int(os.environ.get("BENCH_STEPS", REF_STEPS))
-    k = _chain()
+    k = min(_chain(), steps)  # same budget clamp as the lm path
     if k > 1:
         carry, elapsed, steps = _timed_chain(
             lambda c: step(c[0], sharded, key), (state, metrics),

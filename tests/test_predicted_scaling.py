@@ -5,12 +5,10 @@ themselves and the bench workloads)."""
 import importlib.util
 import json
 import os
-import sys
 
 import pytest
 
 REPO = os.path.join(os.path.dirname(__file__), "..")
-sys.path.insert(0, os.path.join(REPO, "tools"))
 
 
 @pytest.fixture(scope="module")

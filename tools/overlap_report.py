@@ -332,7 +332,11 @@ def run_trace(args) -> dict:
         for k in ("fusion", "conv", "dot", "matmul", "copy", "transpose",
                   "reduce", "scatter", "gather", "select", "broadcast",
                   "add", "mul", "iota", "slice", "concatenate", "pad",
-                  "reshape", "compare", "rsqrt", "exp", "log", "max", "min")
+                  "reshape", "compare", "rsqrt", "exp", "log", "max", "min",
+                  # Pallas/custom kernels and loop bodies are real compute
+                  "custom-call", "custom_call", "while", "subtract",
+                  "divide", "negate", "tanh", "sigmoid", "dynamic",
+                  "flash", "kernel")
     ) and not is_coll(n)
     coll = [(e["ts"], e["ts"] + e["dur"]) for e in spans if is_coll(e["name"])]
     comp_events = [e for e in spans if is_comp(e["name"])]
@@ -379,6 +383,10 @@ def run_trace(args) -> dict:
         "n_collective_events": len(coll),
         "n_compute_events": len(comp),
         "n_skipped_events": len(skipped),
+        # a large skipped share means the keyword filter missed real work
+        # (or the trace is mostly infra) — audit top_skipped_events then
+        "skipped_ms": round(sum(e["dur"] for e in skipped) / 1e3, 3),
+        "compute_ms": round(sum(e["dur"] for e in comp_events) / 1e3, 3),
         "collective_ms": round(coll_time / 1e3, 3),
         "overlapped_ms": round(overlap / 1e3, 3),
         "overlap_fraction": round(overlap / coll_time, 4) if coll_time else None,

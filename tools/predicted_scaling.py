@@ -176,7 +176,13 @@ def main(argv=None) -> dict:
     for n in args.workers:
         for mode in args.modes:
             if MODES[mode].get("hier") and n < 16:
-                continue  # hier needs >=2 hosts of >=8 chips
+                # recorded, not silent: an empty report must be
+                # distinguishable from "nothing was measured"
+                failures.append({
+                    "workers": n, "mode": mode,
+                    "error": "skipped: hier needs >=16 chips (2 hosts x 8)",
+                })
+                continue
             cmd = [sys.executable, os.path.abspath(__file__),
                    "--one-workers", str(n), "--one-mode", mode,
                    "--network", args.network, "--batch", str(args.batch)]
@@ -211,10 +217,12 @@ def main(argv=None) -> dict:
             ),
             "hier_note": (
                 "hier_2round totals count its extra ICI staging bytes at "
-                "the same 45 GB/s as everything else; the design exists "
-                "for DCN-limited pods where the ONE int8 DCN crossing "
-                "per element dominates — this single-bandwidth table "
-                "understates it there"
+                "the same 45 GB/s as everything else AND apply ring "
+                "factors at the total chip count, though its collectives "
+                "actually run over per-host (8-chip) and per-host-group "
+                "subsets; the design exists for DCN-limited pods where "
+                "the ONE int8 DCN crossing per element dominates — this "
+                "single-bandwidth, flat-group table understates it there"
             ),
         },
         "rows": rows,
