@@ -13,7 +13,12 @@ time).
       --run uncompressed=runs/real_digits/resnet18_train.jsonl \\
       --run int8=runs/real_digits/resnet18_int8_train.jsonl \\
       --run 2round_ef=runs/real_digits/resnet18_2round_ef_train.jsonl \\
+      [--eval-log int8=runs/real_digits/resnet18_int8_eval.log ...] \\
       [--out runs/real_digits/compression_convergence.json]
+
+`--eval-log` folds the OUT-OF-BAND polling evaluator's own log (cli/
+evaluate.py "Validation Step:" lines) into the summary next to the
+trainer's in-band numbers, so both provenances live in one artifact.
 """
 
 from __future__ import annotations
@@ -21,6 +26,23 @@ from __future__ import annotations
 import argparse
 import json
 import os
+import re
+
+_EVAL_LINE = re.compile(
+    r"Validation Step:\s*(\d+),\s*Loss:\s*([\d.]+),\s*Prec@1:\s*([\d.]+)"
+)
+
+
+def load_eval_log(path: str) -> list[dict]:
+    """[{step, loss, prec1}] from the polling evaluator's log lines."""
+    out = []
+    with open(path) as f:
+        for line in f:
+            if m := _EVAL_LINE.search(line):
+                out.append({"step": int(m.group(1)),
+                            "loss": float(m.group(2)),
+                            "prec1": float(m.group(3))})
+    return out
 
 
 def load_run(path: str) -> dict:
@@ -68,6 +90,10 @@ def main(argv=None) -> dict:
     p.add_argument("--run", action="append", required=True,
                    metavar="NAME=PATH",
                    help="label=path-to-metrics-jsonl (repeatable)")
+    p.add_argument("--eval-log", action="append", default=[],
+                   metavar="NAME=PATH",
+                   help="label=path-to-out-of-band-evaluator-log "
+                        "(repeatable; label must match a --run)")
     p.add_argument("--out", default=None)
     args = p.parse_args(argv)
 
@@ -77,6 +103,14 @@ def main(argv=None) -> dict:
         if not path:
             raise SystemExit(f"--run wants NAME=PATH, got {spec!r}")
         runs[name] = load_run(path)
+    oob = {}
+    for spec in args.eval_log:
+        name, _, path = spec.partition("=")
+        if not path:
+            raise SystemExit(f"--eval-log wants NAME=PATH, got {spec!r}")
+        if name not in runs:
+            raise SystemExit(f"--eval-log label {name!r} has no --run")
+        oob[name] = load_eval_log(path)
 
     steps = sorted({r["step"] for run in runs.values() for r in run["train"]})
     by_step = {
@@ -94,10 +128,15 @@ def main(argv=None) -> dict:
                     row[f"{name}_prec1"] = round(rec["prec1"], 2)
         table.append(row)
 
-    report = {
-        "summary": {name: summarize(run) for name, run in runs.items()},
-        "per_step": table,
-    }
+    summary = {name: summarize(run) for name, run in runs.items()}
+    for name, evals in oob.items():
+        if evals:
+            summary[name]["oob_eval"] = {
+                "final_prec1": evals[-1]["prec1"],
+                "best_prec1": max(e["prec1"] for e in evals),
+                "steps": [e["step"] for e in evals],
+            }
+    report = {"summary": summary, "per_step": table}
     cols = ["step"] + [f"{n}_{k}" for n in runs for k in ("loss", "prec1")]
     print("  ".join(f"{c:>18}" for c in cols))
     for row in table:

@@ -82,3 +82,35 @@ def test_prepare_data_offline(tmp_path, monkeypatch):
     monkeypatch.setenv("PS_TPU_DATA_DIR", str(tmp_path))
     status = pd.main(["--datasets", "MNIST", "--data-root", str(tmp_path)])
     assert status == {"MNIST": False}
+
+
+def test_compression_convergence_merges_oob_eval(tmp_path, capsys):
+    """--eval-log folds the polling evaluator's own log into the summary
+    next to the trainer's in-band numbers (both provenances, one
+    artifact)."""
+    import json
+
+    from analysis.compression_convergence import main as cc_main
+
+    train = tmp_path / "t.jsonl"
+    train.write_text(
+        '{"kind": "train", "step": 1, "loss": 2.0, "prec1": 10.0, "time_cost": 1.0}\n'
+        '{"kind": "train", "step": 2, "loss": 1.0, "prec1": 50.0, "time_cost": 1.0}\n'
+        '{"kind": "eval", "step": 2, "loss": 0.9, "prec1": 55.0}\n'
+    )
+    ev = tmp_path / "e.log"
+    ev.write_text(
+        "INFO: Validation Step: 1, Loss: 1.5000, Prec@1: 30.00, Prec@5: 80.00\n"
+        "INFO: Validation Step: 2, Loss: 0.9500, Prec@1: 54.50, Prec@5: 99.00\n"
+    )
+    out = tmp_path / "report.json"
+    cc_main(["--run", f"a={train}", "--eval-log", f"a={ev}",
+             "--out", str(out)])
+    rep = json.loads(out.read_text())
+    s = rep["summary"]["a"]
+    assert s["best_eval_prec1"] == 55.0  # in-band (trainer) field
+    assert s["oob_eval"] == {"final_prec1": 54.5, "best_prec1": 54.5,
+                             "steps": [1, 2]}
+    # strict JSON all the way down (no bare NaN)
+    json.loads(out.read_text(), parse_constant=lambda c: (_ for _ in ()).throw(
+        ValueError(f"non-strict JSON constant {c}")))

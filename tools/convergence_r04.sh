@@ -66,5 +66,8 @@ python -m analysis.compression_convergence \
   --run none="$OUT/r04_resnet18_none_train.jsonl" \
   --run int8="$OUT/r04_resnet18_int8_train.jsonl" \
   --run 2round_ef="$OUT/r04_resnet18_2round_ef_train.jsonl" \
+  --eval-log none="$OUT/r04_resnet18_none_eval.log" \
+  --eval-log int8="$OUT/r04_resnet18_int8_eval.log" \
+  --eval-log 2round_ef="$OUT/r04_resnet18_2round_ef_eval.log" \
   --out "$OUT/compression_convergence.json"
 log "all done"
