@@ -161,11 +161,51 @@ def test_attach_banked_uses_parent_metric(bench, tmp_path, monkeypatch):
     rec = {}
     bench._attach_banked(rec)
     assert rec["last_tpu_record"]["value"] == 220555.7
+    # the quotable one-liner names the banked evidence and labels the
+    # record a liveness signal (VERDICT r04 item 7)
+    assert "not a TPU measurement" in rec["headline"]
+    assert "220555.7" in rec["headline"]
     # without the parent key, the shrunken tag matches nothing
     monkeypatch.delenv("BENCH_PARENT_METRIC")
     rec2 = {}
     bench._attach_banked(rec2)
     assert "last_tpu_record" not in rec2
+    assert "no banked TPU record" in rec2["headline"]
+
+
+def test_last_tpu_record_timestamp_tier_and_methodology(
+    bench, tmp_path, monkeypatch
+):
+    """ADVICE r04: (a) an empty/falsy timestamp must rank in the mtime tier
+    (tier and date from the SAME truthy value); (b) the returned copy always
+    carries explicit chain depth + timing methodology so chained
+    (dispatch-amortized) and per-dispatch records can't be confused."""
+    rec_dir = tmp_path / "runs" / "tpu_r99"
+    rec_dir.mkdir(parents=True)
+    key = "lenet_mnist_b8192_train_throughput"
+    # empty timestamp — would have been promoted to the timestamped tier by
+    # the old `"timestamp" in rec` check while dating itself from mtime
+    (rec_dir / "bench_a.json").write_text(json.dumps({
+        "metric": key, "value": 1.0, "device": "TPU v5 lite",
+        "timestamp": "",
+    }))
+    # genuinely timestamped (older than any plausible mtime) must still win
+    (rec_dir / "bench_b.json").write_text(json.dumps({
+        "metric": key, "value": 2.0, "device": "TPU v5 lite",
+        "timestamp": "2020-01-01T00:00:00Z", "chain": 10,
+    }))
+    monkeypatch.setattr(bench, "__file__", str(tmp_path / "bench.py"))
+    got = bench._last_tpu_record(key)
+    assert got["value"] == 2.0
+    assert got["chain"] == 10
+    assert got["timing"] == "chained_fori_loop"
+    # an un-chained record reports per-dispatch methodology explicitly
+    (rec_dir / "bench_b.json").write_text(json.dumps({
+        "metric": key, "value": 2.0, "device": "TPU v5 lite",
+        "timestamp": "2020-01-01T00:00:00Z",
+    }))
+    got = bench._last_tpu_record(key)
+    assert got["chain"] == 1 and got["timing"] == "per_dispatch"
 
 
 def test_validate_env_rejects_non_integer_knobs(bench, monkeypatch):

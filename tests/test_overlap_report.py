@@ -155,3 +155,40 @@ def test_run_trace_excludes_infra_events_from_compute(tmp_path):
     assert rep["overlap_fraction"] == 0.5  # fusion half, not barrier whole
     assert [e["name"] for e in rep["top_compute_events"]] == ["fusion.42"]
     assert [e["name"] for e in rep["top_skipped_events"]] == ["barrier-wait"]
+
+
+def test_run_trace_prefix_anchored_compute_classifier(tmp_path):
+    """Op classification is anchored to the HLO op-name prefix, not free
+    substring search (ADVICE r04): copy-start/copy-done DMA bookkeeping and
+    address-computation thunks contain 'copy'/'dynamic' as substrings but
+    must land in the skipped audit list; the exact 'copy' op and fusion
+    kinds (loop_fusion) are real compute."""
+    import argparse
+
+    meta = {"ph": "M", "name": "process_name", "pid": 7,
+            "args": {"name": "/device:TPU:0"}}
+    coll = {"ph": "X", "pid": 7, "name": "all-reduce.1", "ts": 100, "dur": 100}
+    # infra spans whose names would substring-match the old classifier;
+    # each fully covers the collective, so any misclassification shows up
+    # directly in overlap_fraction
+    infra = [
+        {"ph": "X", "pid": 7, "name": "copy-start.2", "ts": 90, "dur": 200},
+        {"ph": "X", "pid": 7, "name": "copy-done.2", "ts": 90, "dur": 200},
+        {"ph": "X", "pid": 7, "name": "dynamic-address-computation.1",
+         "ts": 90, "dur": 200},
+    ]
+    # real compute overlapping only the back half
+    comp = [
+        {"ph": "X", "pid": 7, "name": "copy.3", "ts": 150, "dur": 25},
+        {"ph": "X", "pid": 7, "name": "loop_fusion.8", "ts": 175, "dur": 25},
+    ]
+    _write_trace(tmp_path, [meta, coll] + infra + comp)
+
+    rep = orp.run_trace(argparse.Namespace(profile_dir=str(tmp_path)))
+    assert rep["n_compute_events"] == 2
+    assert rep["n_skipped_events"] == 3
+    # copy.3 + loop_fusion.8 merge to [150,200] = half the collective
+    assert rep["overlap_fraction"] == 0.5
+    skipped = {e["name"] for e in rep["top_skipped_events"]}
+    assert skipped == {"copy-start.2", "copy-done.2",
+                       "dynamic-address-computation.1"}

@@ -1,0 +1,72 @@
+#!/bin/bash
+# Round-5 convergence legs (round-4 VERDICT item 1): the true-int8-wire
+# mode (2round+EF) re-run with PER-BLOCK quantization scales, which exist
+# precisely to cut per-tensor quantization error (ops/quantize.py) but were
+# never used in the r04 convergence runs.
+#
+# Two fresh legs, identical config to tools/convergence_r04.sh (same data,
+# same steps, same 2-device mesh / global batch 256 — see that script's
+# config-honesty note):
+#   2round_ef_blk128     --quant-block-size 128 --quant-rounding nearest
+#                        (EF's exact on-wire residual pairing, ps.py)
+#   2round_ef_blk128_sr  --quant-block-size 128 --quant-rounding stochastic
+#                        (unbiased rounding; EF residual approximate — the
+#                        documented caveat — measured, not assumed)
+# The merged table re-uses the committed r04 artifacts for none / int8 /
+# per-tensor 2round_ef so all five legs are equal-steps comparable.
+set -u
+cd "$(dirname "$0")/.."
+export PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+export XLA_FLAGS=--xla_force_host_platform_device_count=2
+OUT=runs/real_digits
+mkdir -p "$OUT"
+STEPS=${STEPS:-80}
+log() { echo "[convergence $(date -u +%H:%M:%S)] $*"; }
+
+run_one() {  # run_one <mode-label> <extra train flags...>
+  local mode="$1"; shift
+  local ckdir; ckdir=$(mktemp -d "/tmp/r05_${mode}_XXXX")
+  log "train $mode -> $OUT/r05_resnet18_${mode}_train.jsonl"
+  timeout 7200 python -m ps_pytorch_tpu.cli.evaluate \
+    --network ResNet18 --dataset Cifar10 --model-dir "$ckdir" \
+    --data-root /tmp/real_digits_data --no-synthetic \
+    --poll-interval 45 --timeout 1200 \
+    > "$OUT/r05_resnet18_${mode}_eval.log" 2>&1 &
+  local eval_pid=$!
+  timeout 7200 python -m ps_pytorch_tpu.cli.train \
+    --network ResNet18 --dataset Cifar10 --num-workers 2 --batch-size 128 \
+    --max-steps "$STEPS" --log-interval 5 --eval-freq 20 \
+    --num-aggregate 5 --train-dir "$ckdir" \
+    --data-root /tmp/real_digits_data --no-synthetic \
+    --metrics-file "$OUT/r05_resnet18_${mode}_train.jsonl" "$@" \
+    > "/tmp/r05_${mode}_train.log" 2>&1 \
+    || log "train $mode FAILED (see /tmp/r05_${mode}_train.log)"
+  for _ in $(seq 60); do
+    grep -q "Validation Step: $STEPS," \
+      "$OUT/r05_resnet18_${mode}_eval.log" 2>/dev/null && break
+    sleep 15
+  done
+  kill "$eval_pid" 2>/dev/null
+  wait "$eval_pid" 2>/dev/null
+  log "$mode done; eval log: $(grep -c Validation "$OUT/r05_resnet18_${mode}_eval.log" 2>/dev/null || echo 0) lines"
+}
+
+rm -f "$OUT"/r05_resnet18_*_train.jsonl
+run_one 2round_ef_blk128 --compress-grad 2round --error-feedback \
+  --quant-rounding nearest --quant-block-size 128
+run_one 2round_ef_blk128_sr --compress-grad 2round --error-feedback \
+  --quant-rounding stochastic --quant-block-size 128
+
+python -m analysis.compression_convergence \
+  --run none="$OUT/r04_resnet18_none_train.jsonl" \
+  --run int8="$OUT/r04_resnet18_int8_train.jsonl" \
+  --run 2round_ef="$OUT/r04_resnet18_2round_ef_train.jsonl" \
+  --run 2round_ef_blk128="$OUT/r05_resnet18_2round_ef_blk128_train.jsonl" \
+  --run 2round_ef_blk128_sr="$OUT/r05_resnet18_2round_ef_blk128_sr_train.jsonl" \
+  --eval-log none="$OUT/r04_resnet18_none_eval.log" \
+  --eval-log int8="$OUT/r04_resnet18_int8_eval.log" \
+  --eval-log 2round_ef="$OUT/r04_resnet18_2round_ef_eval.log" \
+  --eval-log 2round_ef_blk128="$OUT/r05_resnet18_2round_ef_blk128_eval.log" \
+  --eval-log 2round_ef_blk128_sr="$OUT/r05_resnet18_2round_ef_blk128_sr_eval.log" \
+  --out "$OUT/compression_convergence.json"
+log "all done"

@@ -326,18 +326,39 @@ def run_trace(args) -> dict:
     # compute = real op events only (fusion/conv/dot/elementwise families),
     # NOT every non-collective span: infra/marker events (barriers, infeed,
     # trace bookkeeping) would otherwise count as overlapped compute and
-    # inflate the fraction quoted as component-#12 evidence
-    is_comp = lambda n: any(
-        k in n.lower()
-        for k in ("fusion", "conv", "dot", "matmul", "copy", "transpose",
-                  "reduce", "scatter", "gather", "select", "broadcast",
-                  "add", "mul", "iota", "slice", "concatenate", "pad",
-                  "reshape", "compare", "rsqrt", "exp", "log", "max", "min",
-                  # Pallas/custom kernels and loop bodies are real compute
-                  "custom-call", "custom_call", "while", "subtract",
-                  "divide", "negate", "tanh", "sigmoid", "dynamic",
-                  "flash", "kernel")
-    ) and not is_coll(n)
+    # inflate the fraction quoted as component-#12 evidence.
+    # Classification is anchored to the HLO op-name PREFIX (the token before
+    # the first '.', '%' stripped) matched EXACTLY against an op set — free
+    # substring search would let copy-start/copy-done DMA bookkeeping or
+    # address-computation thunks ride in on 'copy'/'dynamic'/'while'
+    # substrings (advisor r04). 'copy' the exact op is real data movement;
+    # 'copy-start'/'copy-done' are distinct prefixes and stay unclassified.
+    # Anything unmatched lands in the skipped audit list, not in a bucket.
+    _COMP_OPS = frozenset((
+        "fusion", "convolution", "dot", "transpose", "copy", "reduce",
+        "reduce-window", "scatter", "gather", "select", "broadcast",
+        "add", "multiply", "subtract", "divide", "negate", "iota",
+        "slice", "dynamic-slice", "dynamic-update-slice", "concatenate",
+        "pad", "reshape", "bitcast", "convert", "compare", "rsqrt",
+        # XLA spells these exponential/logistic; keep the short forms too
+        "sqrt", "exp", "exponential", "log", "power", "abs", "maximum",
+        "minimum", "tanh", "sigmoid", "logistic", "clamp",
+        "select-and-scatter",
+        # Pallas/custom kernels and compiled loop bodies are real compute
+        "custom-call", "while",
+    ))
+
+    def _base_op(n: str) -> str:
+        return n.lower().lstrip("%").split(".")[0]
+
+    def is_comp(n: str) -> bool:
+        if is_coll(n):
+            return False
+        base = _base_op(n)
+        # fusion kinds surface as loop_fusion/input_fusion/output_fusion;
+        # Pallas kernels keep their kernel name but are tagged custom-call
+        return (base in _COMP_OPS or base.endswith("fusion")
+                or "flash" in base or "kernel" in base)
     coll = [(e["ts"], e["ts"] + e["dur"]) for e in spans if is_coll(e["name"])]
     comp_events = [e for e in spans if is_comp(e["name"])]
     comp = [(e["ts"], e["ts"] + e["dur"]) for e in comp_events]

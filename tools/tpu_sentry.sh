@@ -8,7 +8,7 @@
 #   nohup bash tools/tpu_sentry.sh >> /tmp/tpu_sentry.log 2>&1 &
 set -u
 cd "$(dirname "$0")/.."
-OUT=${1:-runs/tpu_r04}
+OUT=${1:-runs/tpu_r05}
 LOCK=/tmp/tpu_window.lock
 log() { echo "[sentry $(date -u +%H:%M:%S)] $*"; }
 
@@ -21,8 +21,14 @@ while true; do
       >/dev/null 2>&1; then
     log "tunnel UP — draining window queue"
     if mkdir "$LOCK" 2>/dev/null; then
+      # release the lock even if this shell dies mid-drain — a crashed run
+      # must not wedge every future probe (advisor r04). INT/TERM must also
+      # EXIT, not resume the probe loop after the handler
+      trap 'rmdir "$LOCK" 2>/dev/null' EXIT
+      trap 'rmdir "$LOCK" 2>/dev/null; exit 130' INT TERM
       bash tools/tpu_window.sh "$OUT"
-      rmdir "$LOCK"
+      rmdir "$LOCK" 2>/dev/null
+      trap - EXIT INT TERM
       log "window run finished"
     else
       log "another window run holds $LOCK; skipping"

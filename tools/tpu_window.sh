@@ -7,10 +7,10 @@
 # after a partial window is safe — the persistent compile cache
 # (/tmp/ps_tpu_jax_cache) makes already-banked steps cheap to re-verify.
 #
-# Usage:  bash tools/tpu_window.sh [outdir]     # default runs/tpu_r04
+# Usage:  bash tools/tpu_window.sh [outdir]     # default runs/tpu_r05
 set -u
 cd "$(dirname "$0")/.."
-OUT=${1:-runs/tpu_r04}
+OUT=${1:-runs/tpu_r05}
 mkdir -p "$OUT"
 log() { echo "[tpu_window $(date -u +%H:%M:%S)] $*"; }
 

@@ -31,8 +31,12 @@ def main(outdir: str) -> int:
         print("|---|---|---|---|---|---|---|---|")
         for p in bench:
             r = _load(p)
-            if "error" in r and "metric" not in r:
-                print(f"| {os.path.basename(p)} | UNREADABLE: {r['error']} | | | | | | |")
+            # any record carrying "error" renders as an ERROR row — bench
+            # error records have BOTH "metric" and "error" (value null), and
+            # must not render as a normal parity row of value 0
+            if "error" in r:
+                print(f"| {os.path.basename(p)} | ERROR ({r.get('metric', 'unreadable')}): "
+                      f"{str(r['error'])[:160]} | | | | | | |")
                 continue
             print("| {stem} | {metric} | {value:,} | {unit} | {vs} | {mfu} | {chain} | {ts} |".format(
                 stem=os.path.basename(p)[len("bench_"):-len(".json")],
