@@ -206,6 +206,74 @@ def test_error_feedback_trains_and_carries_residuals(mesh, compress):
     assert any(float(jnp.max(jnp.abs(l))) > 0 for l in leaves)
 
 
+def test_ef_untracked_round2_noise_measured(mesh):
+    """Quantify the round-2 requantization noise EF does NOT track (r04
+    VERDICT item 5): on real LeNet gradients through the real aggregation
+    path, measure ||2round_wire_output - mean(round1_contributions)|| —
+    the gap between what the wire actually delivered and what the EF
+    residual accounting assumes it delivered. Pins (a) the magnitude of
+    the untracked noise relative to the aggregate and (b) that block-128
+    scales shrink it vs per-tensor — the mechanism the r05 convergence
+    legs lean on."""
+    from ps_pytorch_tpu.models import apply_model
+    from ps_pytorch_tpu.ops.metrics import cross_entropy_loss
+    from ps_pytorch_tpu.parallel.collectives import aggregate_gradients
+
+    model = build_model("LeNet")
+    params = model.init(
+        jax.random.key(0), jnp.zeros((1, 28, 28, 1), jnp.float32), train=False
+    )["params"]
+    rng = np.random.RandomState(7)
+    # per-worker disjoint real batches => genuine gradient heterogeneity
+    images = jnp.asarray(rng.rand(N, 16, 28, 28, 1).astype(np.float32))
+    labels = jnp.asarray(rng.randint(0, 10, (N, 16)).astype(np.int32))
+
+    def rel_untracked(block):
+        def body(x, y):
+            def loss_fn(p):
+                logits, _ = apply_model(model, p, {}, x[0], train=False)
+                return cross_entropy_loss(logits, y[0])
+
+            grads = jax.grad(loss_fn)(params)
+            agg, contrib = aggregate_gradients(
+                grads, "workers", N, compress="int8_2round",
+                quant_block_size=block, return_contribution=True,
+            )
+            # the EF accounting's view of the aggregate: every worker's
+            # round-1 transmitted value, exactly averaged (round 2 assumed
+            # lossless)
+            ef_view = jax.tree.map(
+                lambda c: jax.lax.psum(c, "workers") / N, contrib
+            )
+            return agg, ef_view
+
+        agg, ef_view = jax.jit(
+            jax.shard_map(
+                body, mesh=mesh,
+                in_specs=(P("workers"), P("workers")),
+                out_specs=P(), check_vma=False,
+            )
+        )(images, labels)
+        num = sum(
+            float(jnp.sum((a - e) ** 2))
+            for a, e in zip(jax.tree.leaves(agg), jax.tree.leaves(ef_view))
+        )
+        den = sum(
+            float(jnp.sum(a**2)) for a in jax.tree.leaves(agg)
+        )
+        return float(np.sqrt(num / den))
+
+    per_tensor = rel_untracked(0)
+    per_block = rel_untracked(128)
+    # measured on this config: per-tensor 1.5e-2, block-128 8.0e-3 —
+    # round-2 noise is ~1-2% of the aggregate's norm, and block scales
+    # halve it. The assertions pin the measured order of magnitude with
+    # headroom, not the exact draw.
+    assert per_block < per_tensor, (per_block, per_tensor)
+    assert per_tensor < 0.05, per_tensor
+    assert per_block < 0.02, per_block
+
+
 def test_error_feedback_accumulates_masked_gradients(mesh):
     """With first_k masking, excluded workers transmit nothing — their
     residual must hold their ENTIRE (feedback-corrected) gradient."""
