@@ -98,8 +98,15 @@ ENTRY %main () -> f32[4] {
 
 def test_analyze_schedule_generic_async_wrapper():
     # collectives without dedicated -start ops ship as generic async-start
-    # wrappers naming the wrapped op; these must still count as comm
+    # wrappers naming the wrapped op; these must still count as comm, and
+    # their replica_groups — printed on the WRAPPED instruction inside its
+    # own computation, not the -start line — must still be resolved
     hlo = """\
+%wrapped_reduce_scatter.3 (p.1: f32[8]) -> f32[4] {
+  %p.1 = f32[8]{0} parameter(0)
+  ROOT %reduce-scatter.9 = f32[4]{0} reduce-scatter(%p.1), replica_groups={{0,1},{2,3}}, dimensions={0}
+}
+
 ENTRY %main () -> f32[4] {
   %x = f32[8]{0} parameter(0)
   %async-start.1 = ((f32[8]{0}), f32[4]{0}, u32[]) async-start(%x), calls=%wrapped_reduce_scatter.3
@@ -114,6 +121,22 @@ ENTRY %main () -> f32[4] {
     assert a["kind"] == "reduce-scatter"
     assert a["compute_ops_between"] == 1
     assert a["bytes"] == 4 * 4  # -done result f32[4]
+    assert a["groups"] == [[0, 1], [2, 3]]
+
+
+def test_replica_groups_explicit_and_iota():
+    assert orp._replica_groups(
+        "all-reduce(%x), replica_groups={{0,1,2,3},{4,5,6,7}}, channel_id=1"
+    ) == [[0, 1, 2, 3], [4, 5, 6, 7]]
+    assert orp._replica_groups(
+        "all-reduce(%x), replica_groups=[4,8]<=[32]"
+    ) == [list(range(i * 8, (i + 1) * 8)) for i in range(4)]
+    # transposed iota: reshape iota(32) to (4,8), T(1,0) -> rows stride 8
+    got = orp._replica_groups(
+        "all-to-all(%x), replica_groups=[8,4]<=[4,8]T(1,0)"
+    )
+    assert got[0] == [0, 8, 16, 24] and got[7] == [7, 15, 23, 31]
+    assert orp._replica_groups("all-reduce(%x), channel_id=1") is None
 
 
 def test_analyze_schedule_no_entry():

@@ -89,11 +89,86 @@ def _opcode(line: str):
     return (m.group(1) if m else None), rhs
 
 
+def _replica_groups(rhs: str):
+    """Parse a collective's replica_groups attribute into a list of device-id
+    lists, or None if absent. Handles both syntaxes XLA prints:
+      explicit  replica_groups={{0,1,2,3},{4,5,6,7}}
+      iota      replica_groups=[4,8]<=[32]          (reshape of iota)
+                replica_groups=[8,4]<=[4,8]T(1,0)   (transposed reshape)
+    The iota form [G,S]<=[dims](T(perm))? means: take iota(prod(dims)),
+    reshape to dims, optionally transpose by perm, then reshape to G rows
+    of S — the rows are the groups."""
+    m = re.search(r"replica_groups=\{\{([\d,{}\s]*)\}\}", rhs)
+    if m:
+        return [
+            [int(x) for x in grp.split(",") if x.strip()]
+            for grp in re.split(r"\}\s*,\s*\{", m.group(1))
+            if grp.strip()
+        ]
+    m = re.search(
+        r"replica_groups=\[(\d+),(\d+)\]<=\[([\d,]+)\](?:T\(([\d,]+)\))?",
+        rhs,
+    )
+    if m:
+        g, s = int(m.group(1)), int(m.group(2))
+        dims = [int(d) for d in m.group(3).split(",")]
+        n = 1
+        for d in dims:
+            n *= d
+        if n != g * s:
+            return None
+        ids = list(range(n))
+        if m.group(4):  # transpose: walk the reshaped iota in perm order
+            perm = [int(p) for p in m.group(4).split(",")]
+            # strides of the original dims layout (row-major)
+            strides = [1] * len(dims)
+            for i in range(len(dims) - 2, -1, -1):
+                strides[i] = strides[i + 1] * dims[i + 1]
+            out = []
+            def walk(depth, off):
+                if depth == len(perm):
+                    out.append(off)
+                    return
+                d = perm[depth]
+                for i in range(dims[d]):
+                    walk(depth + 1, off + i * strides[d])
+            walk(0, 0)
+            ids = out
+        return [ids[i * s:(i + 1) * s] for i in range(g)]
+    return None
+
+
+def _wrapped_groups(rhs: str, comp_groups: dict):
+    """Groups of an async wrapper's wrapped collective: resolve the
+    calls=%target against the computation->groups map."""
+    m = re.search(r"calls=(%[\w.\-]+)", rhs)
+    return comp_groups.get(m.group(1)) if m else None
+
+
 def analyze_hlo_schedule(hlo_text: str) -> dict:
     """Walk the scheduled entry computation; report every collective with
     the compute placed between its start/done pair (async) or its schedule
     position (sync)."""
     lines = hlo_text.splitlines()
+    # replica_groups of collectives hidden inside non-entry computations:
+    # XLA's generic async wrappers (`async-start ..., calls=%wrapped_x`)
+    # print the groups attribute on the WRAPPED instruction in its own
+    # computation, not on the -start line — map computation name -> groups
+    # so the wrapper's collective still gets classified
+    comp_groups: dict = {}
+    current_comp = None
+    for l in lines:
+        m = re.match(r"\s*(%[\w.\-]+)\s*(?:\([^)]*\))?\s*.*\{\s*$", l)
+        if m and "=" not in l.split("{")[0]:
+            current_comp = m.group(1)
+            continue
+        if l.startswith("}") or l.strip() == "}":
+            current_comp = None
+            continue
+        if current_comp and "replica_groups=" in l:
+            g = _replica_groups(l)
+            if g is not None and current_comp not in comp_groups:
+                comp_groups[current_comp] = g
     # entry computation: from 'ENTRY' to the closing brace at depth 0
     try:
         start = next(i for i, l in enumerate(lines) if l.startswith("ENTRY"))
@@ -169,6 +244,10 @@ def analyze_hlo_schedule(hlo_text: str) -> dict:
                 "done_pos": o["i"],
                 "compute_ops_between": len(between),
                 "overlapped": len(between) > 0,
+                # dedicated -start ops carry replica_groups inline; generic
+                # async wrappers keep it on the wrapped computation
+                "groups": _replica_groups(s["rhs"])
+                or _wrapped_groups(s["rhs"], comp_groups),
             })
         elif o["op"] in COLLECTIVE_OPS:
             after = [i for i in compute_idx if i > o["i"]]
@@ -179,6 +258,7 @@ def analyze_hlo_schedule(hlo_text: str) -> dict:
                 "pos": o["i"],
                 "schedule_len": len(body),
                 "compute_ops_after": len(after),
+                "groups": _replica_groups(o["rhs"]),
             })
 
     return {

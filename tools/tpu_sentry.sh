@@ -13,7 +13,7 @@ LOCK=/tmp/tpu_window.lock
 log() { echo "[sentry $(date -u +%H:%M:%S)] $*"; }
 
 while true; do
-  if [ -f "$OUT/bench_lm_d2048x4_s2048.json" ]; then
+  if [ -f "$OUT/tpu_validate.json" ]; then
     log "final queue artifact exists; sentry done"
     exit 0
   fi

@@ -7,6 +7,14 @@
 # after a partial window is safe — the persistent compile cache
 # (/tmp/ps_tpu_jax_cache) makes already-banked steps cheap to re-verify.
 #
+# Priority (r04 VERDICT item 2): (1) chained headline rebanks — compiles
+# are cached from r03, these are fast and give BENCH_r05 a live capture;
+# (2) component-#12 evidence (profile trace + AOT topology), the one open
+# parity IOU; (3) MFU-targeted bf16/flash records (>=40% target);
+# (4) compression-mode records; (5) seq-8192 long-context; (6) validator
+# sweeps (longest timeouts last so a dying window can't strand the queue
+# on them).
+#
 # Usage:  bash tools/tpu_window.sh [outdir]     # default runs/tpu_r05
 set -u
 cd "$(dirname "$0")/.."
@@ -38,35 +46,19 @@ if ! timeout 280 python -c "import jax; assert jax.default_backend()=='tpu', jax
 fi
 log "tunnel UP"
 
-# 1. headline bench records. BENCH_CHAIN=10 amortizes the tunnel's ~24 ms
-#    per-dispatch floor (r03's lenet record was 7 ms/step of device work —
-#    i.e. dispatch-bound; chained records measure the chip). The record
-#    carries "chain": 10 for transparency.
+# 1. headline bench records, CHAINED (BENCH_CHAIN=10 amortizes the ~24 ms
+#    per-dispatch tunnel floor; r03's records were dispatch-bound). Same
+#    metric keys as r03 for cross-round continuity; the chain depth rides
+#    in the record's "chain"/"timing" fields.
 bank_bench bench_lenet BENCH_WORKLOAD=lenet BENCH_CHAIN=10
 bank_bench bench_resnet18 BENCH_WORKLOAD=resnet18 BENCH_CHAIN=10
-# same metric key as r03's record (naive attention) for cross-round
-# continuity + default-config evidence lookup; the flash variant is a
-# SEPARATE record with its own _flash metric key
 bank_bench bench_lm_1k BENCH_WORKLOAD=lm BENCH_CHAIN=10
 bank_bench bench_lm_1k_flash BENCH_WORKLOAD=lm BENCH_CHAIN=10 BENCH_LM_FLASH=1
 
-# 2. long-context LM: seq 8192 + flash, b=2 (b=8 x depth=6 hangs the
-#    remote-compile helper — bisection in runs/tpu_r03/NOTES.md)
-bank_bench bench_lm_8k_flash BENCH_WORKLOAD=lm BENCH_LM_SEQ=8192 \
-  BENCH_LM_FLASH=1 BENCH_LM_BATCH=2 BENCH_CHAIN=5
-
-# 3. compiled Pallas validation, quick first (banks a full compiled-parity
-#    report fast), then the full sweep incl. T=1000 pad-and-mask
-log "tpu_validate quick"
-timeout 580 python tools/tpu_validate.py --quick --seq-lens 1000 2048 \
-  --out "$OUT/tpu_validate_quick.json" 2>"$OUT/tpu_validate_quick.err" \
-  || log "tpu_validate quick FAILED (see $OUT/tpu_validate_quick.err)"
-log "tpu_validate full"
-timeout 1800 python tools/tpu_validate.py --out "$OUT/tpu_validate.json" \
-  2>"$OUT/tpu_validate.err" \
-  || log "tpu_validate full FAILED (see $OUT/tpu_validate.err)"
-
-# 4. profile trace of single-chip ResNet18 PS training + timeline analysis
+# 2. component-#12 evidence — profile trace of single-chip ResNet18 PS
+#    training + timeline analysis, then the AOT topology schedule for the
+#    8-chip program (real TPU compiler schedule without 8 chips; an error
+#    record is evidence either way)
 log "profile trace"
 rm -rf "$OUT/profile"
 timeout 580 python -m ps_pytorch_tpu.cli.train --network ResNet18 \
@@ -76,39 +68,51 @@ timeout 580 python -m ps_pytorch_tpu.cli.train --network ResNet18 \
   || log "profile train FAILED (see $OUT/profile_train.log)"
 timeout 280 python tools/overlap_report.py trace --profile-dir "$OUT/profile" \
   --out "$OUT/overlap_trace.json" || log "trace analysis failed"
-
-# 5. AOT topology compile of the 8-chip program (the component-#12 prize:
-#    real TPU compiler schedule without 8 chips) — may be unsupported by
-#    the tunnel plugin; the error record is evidence either way
 log "topology AOT"
 timeout 580 python tools/overlap_report.py topology --workers 8 \
   --out "$OUT/overlap_topology.json" 2>"$OUT/overlap_topology.err" \
   || log "topology AOT failed (see $OUT/overlap_topology.err)"
 
-# 5b. MXU-native mixed-precision CNN record (params f32, compute bf16 —
-#     the trainer's --dtype bfloat16 config; default record stays f32 for
-#     like-for-like math vs the reference)
+# 3. MFU-targeted records (stated target: >=40%; r03 measured 22% on
+#    naive f32 attention). bf16 flash LM at the headline shape, then the
+#    larger-matmul probes (d1024x8 / d2048x4 — NEW compiles, ~5 min each
+#    through the tunnel's remote-compile helper).
+bank_bench bench_lm_1k_bf16_flash BENCH_WORKLOAD=lm BENCH_CHAIN=10 \
+  BENCH_LM_FLASH=1 BENCH_DTYPE=bfloat16
 bank_bench bench_resnet18_bf16 BENCH_WORKLOAD=resnet18 BENCH_DTYPE=bfloat16 \
   BENCH_CHAIN=10
+bank_bench bench_lm_d1024x8_s2048 BENCH_WORKLOAD=lm BENCH_LM_DIM=1024 \
+  BENCH_LM_DEPTH=8 BENCH_LM_SEQ=2048 BENCH_LM_BATCH=4 BENCH_LM_FLASH=1 \
+  BENCH_CHAIN=10 BENCH_DTYPE=bfloat16
+bank_bench bench_lm_d2048x4_s2048 BENCH_WORKLOAD=lm BENCH_LM_DIM=2048 \
+  BENCH_LM_DEPTH=4 BENCH_LM_SEQ=2048 BENCH_LM_BATCH=2 BENCH_LM_FLASH=1 \
+  BENCH_CHAIN=10 BENCH_DTYPE=bfloat16
 
-# 5a2. the true-int8-wire mode (the predicted-scaling artifact's winning
-#      config) and the uncompressed baseline, same canonical workload
+# 4. compression-mode records: the true-int8-wire mode (the predicted-
+#    scaling artifact's winning config) and the uncompressed baseline
 bank_bench bench_resnet18_2round BENCH_WORKLOAD=resnet18 \
   BENCH_COMPRESS=int8_2round BENCH_CHAIN=10
 bank_bench bench_resnet18_nocomp BENCH_WORKLOAD=resnet18 \
   BENCH_COMPRESS=none BENCH_CHAIN=10
 
-# 5c. serving-side record: KV-cache autoregressive generation
+# 5. long-context LM: seq 8192 + flash, b=2 (b=8 x depth=6 hangs the
+#    remote-compile helper — bisection in runs/tpu_r03/NOTES.md), and the
+#    serving-side KV-cache generation record
+bank_bench bench_lm_8k_flash BENCH_WORKLOAD=lm BENCH_LM_SEQ=8192 \
+  BENCH_LM_FLASH=1 BENCH_LM_BATCH=2 BENCH_CHAIN=5
 bank_bench bench_decode BENCH_WORKLOAD=decode
 
-# 6. MFU scaling probe: larger LM configs (stated target: >=40% MFU on LM;
-#    d512x6 measured 22% — bigger matmuls should close the gap)
-bank_bench bench_lm_d1024x8_s2048 BENCH_WORKLOAD=lm BENCH_LM_DIM=1024 \
-  BENCH_LM_DEPTH=8 BENCH_LM_SEQ=2048 BENCH_LM_BATCH=4 BENCH_LM_FLASH=1 \
-  BENCH_CHAIN=10
-bank_bench bench_lm_d2048x4_s2048 BENCH_WORKLOAD=lm BENCH_LM_DIM=2048 \
-  BENCH_LM_DEPTH=4 BENCH_LM_SEQ=2048 BENCH_LM_BATCH=2 BENCH_LM_FLASH=1 \
-  BENCH_CHAIN=10
+# 6. compiled Pallas validation, quick first (banks a full compiled-parity
+#    report fast), then the full sweep incl. T=1000 pad-and-mask — the
+#    longest timeouts sit LAST so a dying window can't strand the queue
+log "tpu_validate quick"
+timeout 580 python tools/tpu_validate.py --quick --seq-lens 1000 2048 \
+  --out "$OUT/tpu_validate_quick.json" 2>"$OUT/tpu_validate_quick.err" \
+  || log "tpu_validate quick FAILED (see $OUT/tpu_validate_quick.err)"
+log "tpu_validate full"
+timeout 1800 python tools/tpu_validate.py --out "$OUT/tpu_validate.json" \
+  2>"$OUT/tpu_validate.err" \
+  || log "tpu_validate full FAILED (see $OUT/tpu_validate.err)"
 
 log "window drained; artifacts in $OUT:"
 ls -la "$OUT"
