@@ -41,20 +41,29 @@ def main(argv=None) -> dict:
     args = p.parse_args(argv)
 
     os.environ.setdefault("PS_TPU_PALLAS_INTERPRET", "1")
-    # self-scrub to a virtual CPU mesh when the caller hasn't configured
-    # one: this is a CPU correctness check, and an unscrubbed run would
-    # either hang on the dead-tunnel axon plugin (JAX_PLATFORMS alone does
-    # NOT stop it) or fail make_seq_mesh on a 1-device backend
-    os.environ.setdefault("PALLAS_AXON_POOL_IPS", "")
-    if not os.environ.get("JAX_PLATFORMS"):
-        os.environ["JAX_PLATFORMS"] = "cpu"
-    if "xla_force_host_platform_device_count" not in os.environ.get(
-        "XLA_FLAGS", ""
-    ):
-        os.environ["XLA_FLAGS"] = (
-            os.environ.get("XLA_FLAGS", "")
-            + f" --xla_force_host_platform_device_count={args.devices}"
-        ).strip()
+    # this tool is a CPU correctness check by definition, and the ambient
+    # sitecustomize registers the axon TPU plugin at INTERPRETER STARTUP —
+    # in-process env edits are too late, and a dead tunnel then hangs
+    # backend init. Re-exec under the one canonical scrub instead (same
+    # pattern as conftest.py / __graft_entry__.py).
+    from tpu_env import clean_cpu_env, env_is_clean
+
+    if not env_is_clean(args.devices):
+        import subprocess
+
+        # inherit the caller's cwd so a relative --out lands where asked;
+        # imports resolve through the absolute REPO sys.path entry
+        proc = subprocess.run(
+            [sys.executable, os.path.abspath(__file__)]
+            + (sys.argv[1:] if argv is None else list(argv)),
+            env=clean_cpu_env(n_devices=args.devices),
+            capture_output=True, text=True,
+        )
+        sys.stdout.write(proc.stdout)
+        sys.stderr.write(proc.stderr)
+        if proc.returncode != 0:
+            raise SystemExit(proc.returncode)
+        return json.loads(proc.stdout)
 
     import jax
     import jax.numpy as jnp

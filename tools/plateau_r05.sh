@@ -1,0 +1,63 @@
+#!/bin/bash
+# Plateau-parity convergence runs (r04 VERDICT item 3): extend the
+# uncompressed baseline AND the winning bandwidth-honest compressed config
+# (2round+EF with block-128 scales, chosen by tools/convergence_r05.sh's
+# equal-steps legs) to the uncompressed PLATEAU, with the out-of-band
+# polling evaluator watching each run — the reference's published story is
+# full training runs with compression on (run_pytorch.sh), not 80-step
+# trajectories.
+#
+# Same config-honesty as convergence_r04.sh/r05.sh: ResNet18,
+# --num-aggregate 5, 2-device mesh, global batch 256, real-digits
+# CIFAR-10 stand-in. 300 steps/mode (~27 epochs) x ~15 s/step on this
+# 1-core host.
+set -u
+cd "$(dirname "$0")/.."
+export PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+export XLA_FLAGS=--xla_force_host_platform_device_count=2
+OUT=runs/real_digits
+mkdir -p "$OUT"
+STEPS=${STEPS:-300}
+ROUNDING=${ROUNDING:-nearest}
+log() { echo "[plateau $(date -u +%H:%M:%S)] $*"; }
+
+run_one() {  # run_one <mode-label> <extra train flags...>
+  local mode="$1"; shift
+  local ckdir; ckdir=$(mktemp -d "/tmp/plateau_${mode}_XXXX")
+  log "train $mode -> $OUT/plateau_resnet18_${mode}_train.jsonl"
+  timeout 14400 python -m ps_pytorch_tpu.cli.evaluate \
+    --network ResNet18 --dataset Cifar10 --model-dir "$ckdir" \
+    --data-root /tmp/real_digits_data --no-synthetic \
+    --poll-interval 45 --timeout 2400 \
+    > "$OUT/plateau_resnet18_${mode}_eval.log" 2>&1 &
+  local eval_pid=$!
+  timeout 14400 python -m ps_pytorch_tpu.cli.train \
+    --network ResNet18 --dataset Cifar10 --num-workers 2 --batch-size 128 \
+    --max-steps "$STEPS" --log-interval 10 --eval-freq 50 \
+    --num-aggregate 5 --train-dir "$ckdir" \
+    --data-root /tmp/real_digits_data --no-synthetic \
+    --metrics-file "$OUT/plateau_resnet18_${mode}_train.jsonl" "$@" \
+    > "/tmp/plateau_${mode}_train.log" 2>&1 \
+    || log "train $mode FAILED (see /tmp/plateau_${mode}_train.log)"
+  for _ in $(seq 80); do
+    grep -q "Validation Step: $STEPS," \
+      "$OUT/plateau_resnet18_${mode}_eval.log" 2>/dev/null && break
+    sleep 15
+  done
+  kill "$eval_pid" 2>/dev/null
+  wait "$eval_pid" 2>/dev/null
+  log "$mode done; eval: $(grep -c Validation "$OUT/plateau_resnet18_${mode}_eval.log" 2>/dev/null || echo 0) lines"
+}
+
+rm -f "$OUT"/plateau_resnet18_*_train.jsonl
+run_one none
+run_one 2round_ef_blk128 --compress-grad 2round --error-feedback \
+  --quant-rounding "$ROUNDING" --quant-block-size 128
+
+python -m analysis.compression_convergence \
+  --run none="$OUT/plateau_resnet18_none_train.jsonl" \
+  --run 2round_ef_blk128="$OUT/plateau_resnet18_2round_ef_blk128_train.jsonl" \
+  --eval-log none="$OUT/plateau_resnet18_none_eval.log" \
+  --eval-log 2round_ef_blk128="$OUT/plateau_resnet18_2round_ef_blk128_eval.log" \
+  --out "$OUT/plateau_convergence.json"
+log "all done"
