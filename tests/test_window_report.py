@@ -1,0 +1,51 @@
+"""The drained-window report renderer (tools/window_report.py) is a pure
+reader over banked evidence; pin its three bench-record row shapes —
+success, bench-error (has BOTH 'metric' and 'error'), unreadable JSON —
+so an error record can never render as a normal value-0 parity row
+(ADVICE r04)."""
+
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", "tools"))
+
+import window_report as wr  # noqa: E402
+
+
+def _run(outdir, capsys):
+    rc = wr.main(str(outdir))
+    assert rc == 0
+    return capsys.readouterr().out
+
+
+def test_success_error_and_unreadable_rows(tmp_path, capsys):
+    (tmp_path / "bench_good.json").write_text(json.dumps({
+        "metric": "resnet18_cifar10_b1024_train_throughput",
+        "value": 15298.6, "unit": "images/sec", "vs_baseline": 9.83,
+        "mfu": 0.2256, "chain": 10, "timestamp": "2026-07-31T00:00:00Z",
+    }))
+    # bench error records carry metric AND error with value null
+    (tmp_path / "bench_err.json").write_text(json.dumps({
+        "metric": "lm_d512x6_s1024_b8_train_tokens_per_sec",
+        "value": None, "unit": "tokens/sec", "vs_baseline": None,
+        "error": "compile timeout after 580s",
+    }))
+    (tmp_path / "bench_bad.json").write_text("{not json")
+    out = _run(tmp_path, capsys)
+
+    # success row renders value + chain
+    good = next(l for l in out.splitlines() if "15,298.6" in l)
+    assert "9.83" in good and "| 10 |" in good
+    # error row is marked ERROR with its metric and message, not value 0
+    err = next(l for l in out.splitlines() if "bench_err" in l)
+    assert "ERROR" in err and "compile timeout" in err
+    assert "| 0 |" not in err
+    # unreadable file renders as an ERROR row too
+    bad = next(l for l in out.splitlines() if "bench_bad" in l)
+    assert "ERROR" in bad
+
+
+def test_empty_dir_message(tmp_path, capsys):
+    out = _run(tmp_path / "nothing", capsys)
+    assert "no bench_*.json" in out
