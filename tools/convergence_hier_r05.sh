@@ -1,0 +1,48 @@
+#!/bin/bash
+# Hierarchical (DCN x ICI) true-int8-wire convergence leg: the hier_2round
+# scheme end-to-end through the REAL trainer CLI on a virtual 2-host x
+# 2-chip hybrid mesh — the per-axis predicted-scaling table says this is
+# the winning scheme on DCN-limited pods; this banks evidence that it also
+# CONVERGES through the product path (collectives.quantized_allreduce_2round_hier,
+# EF mirroring the inner-ring round-1 transform).
+#
+# Same dataset/config honesty as convergence_r05.sh: global batch 256
+# (4 x 64), 80 steps, out-of-band evaluator. Comparable to the flat legs
+# in runs/real_digits/compression_convergence.json (same data, same
+# global batch, same step count; 4-way instead of 2-way data parallelism).
+set -u
+cd "$(dirname "$0")/.."
+export PALLAS_AXON_POOL_IPS= JAX_PLATFORMS=cpu
+export XLA_FLAGS=--xla_force_host_platform_device_count=4
+OUT=runs/real_digits
+mkdir -p "$OUT"
+STEPS=${STEPS:-80}
+log() { echo "[hier-convergence $(date -u +%H:%M:%S)] $*"; }
+
+mode=hier_2round_ef_blk128
+ckdir=$(mktemp -d "/tmp/r05_${mode}_XXXX")
+log "train $mode -> $OUT/r05_resnet18_${mode}_train.jsonl"
+timeout 7200 python -m ps_pytorch_tpu.cli.evaluate \
+  --network ResNet18 --dataset Cifar10 --model-dir "$ckdir" \
+  --data-root /tmp/real_digits_data --no-synthetic \
+  --poll-interval 45 --timeout 1200 \
+  > "$OUT/r05_resnet18_${mode}_eval.log" 2>&1 &
+eval_pid=$!
+timeout 7200 python -m ps_pytorch_tpu.cli.train \
+  --network ResNet18 --dataset Cifar10 --num-workers 4 --dcn-hosts 2 \
+  --batch-size 64 --max-steps "$STEPS" --log-interval 5 --eval-freq 20 \
+  --num-aggregate 5 --train-dir "$ckdir" \
+  --data-root /tmp/real_digits_data --no-synthetic \
+  --compress-grad 2round --error-feedback \
+  --quant-rounding nearest --quant-block-size 128 \
+  --metrics-file "$OUT/r05_resnet18_${mode}_train.jsonl" \
+  > "/tmp/r05_${mode}_train.log" 2>&1 \
+  || log "train $mode FAILED (see /tmp/r05_${mode}_train.log)"
+for _ in $(seq 60); do
+  grep -q "Validation Step: $STEPS," \
+    "$OUT/r05_resnet18_${mode}_eval.log" 2>/dev/null && break
+  sleep 15
+done
+kill "$eval_pid" 2>/dev/null
+wait "$eval_pid" 2>/dev/null
+log "$mode done; eval: $(grep -c Validation "$OUT/r05_resnet18_${mode}_eval.log" 2>/dev/null || echo 0) lines"
