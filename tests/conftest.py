@@ -83,6 +83,9 @@ _SLOW_TESTS = {
     "test_hierarchical_2round_ef_trains",
     "test_vocab_parallel_tp_matches_replicated",
     "test_stochastic_quantized_step_runs",
+    # round-5 additions (measured ~40s on the 1-core host: two shard_map
+    # compiles of the 2round wire + contribution path on real gradients)
+    "test_ef_untracked_round2_noise_measured",
 }
 
 
