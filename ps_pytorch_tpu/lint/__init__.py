@@ -1,0 +1,57 @@
+"""pslint — JAX/TPU-aware static analysis for the hot path.
+
+The framework's wins (compression, overlap, hierarchical aggregation) are
+erased by a single silent recompilation, a stray host sync in the trainer
+loop, or a mistyped mesh-axis name — failure modes XLA accepts without
+complaint and code review rarely catches. pslint guards them with AST
+rules, each with a stable ID:
+
+  PSL001  mesh-axis consistency: string-literal axis names passed to
+          collectives/PartitionSpec must match the ``*_AXIS`` constants
+          declared in ``parallel/`` (and should BE the constants).
+  PSL002  recompilation hazards: ``jax.jit`` inside loops, jit on a fresh
+          lambda, jit compiled-and-discarded at the call site.
+  PSL003  impure traced functions: ``print``, wall-clock reads,
+          ``np.random.*``, closure/global mutation inside functions that
+          jax traces (jit / shard_map / scan / grad / vmap bodies).
+  PSL004  hidden host syncs in hot paths: ``.item()``, ``float(device)``,
+          ``np.asarray(device)``, ``jax.device_get`` inside trainer-loop
+          bodies without an explicit ``# psl: sync-ok`` pragma.
+  PSL005  donated-buffer reuse: reading a variable after it was passed in
+          a ``donate_argnums`` position (invalid buffer on TPU; CPU only
+          warns, so tests pass locally and crash on the pod).
+
+Usage:
+    python -m ps_pytorch_tpu.lint [paths] [--format json] \
+        [--baseline lint_baseline.json] [--write-baseline]
+
+Suppression: ``# psl: ignore`` (all rules on that line),
+``# psl: ignore[PSL001,PSL004]`` (specific rules), ``# psl: sync-ok``
+(alias for ignore[PSL004]), ``# psl: donate-ok`` (alias for
+ignore[PSL005]). Legacy findings live in a checked-in baseline
+(``lint_baseline.json``) so they don't block CI; new findings fail tier-1
+via tests/test_lint.py.
+"""
+
+from .core import (
+    Finding,
+    apply_baseline,
+    baseline_counts,
+    lint_paths,
+    load_baseline,
+    render_text,
+    to_baseline_json,
+)
+from .rules import RULE_IDS, RULES
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "RULE_IDS",
+    "apply_baseline",
+    "baseline_counts",
+    "lint_paths",
+    "load_baseline",
+    "render_text",
+    "to_baseline_json",
+]

@@ -20,6 +20,8 @@ from jax.sharding import PartitionSpec as P
 from ps_pytorch_tpu.models import build_model
 from ps_pytorch_tpu.optim import sgd
 from ps_pytorch_tpu.parallel import (
+    DCN_AXIS,
+    WORKER_AXIS,
     PSConfig,
     init_ps_state,
     make_mesh,
@@ -39,7 +41,7 @@ N = 8
 
 @pytest.fixture(scope="module")
 def mesh():
-    return make_mesh(num_workers=N, axis_name="workers")
+    return make_mesh(num_workers=N, axis_name=WORKER_AXIS)
 
 
 def _tree(seed, shapes=((33, 7), (129,), (5, 5, 3))):
@@ -52,7 +54,7 @@ def _run_collective(mesh, fn, tree):
     but per-worker scaled values (so workers genuinely differ)."""
 
     def body(t):
-        w = jax.lax.axis_index("workers").astype(jnp.float32)
+        w = jax.lax.axis_index(WORKER_AXIS).astype(jnp.float32)
         local = jax.tree.map(lambda g: g * (1.0 + 0.1 * w), t)
         return fn(local)
 
@@ -69,12 +71,12 @@ def test_2round_close_to_exact_mean(mesh, block):
     got = _run_collective(
         mesh,
         lambda t: quantized_allreduce_2round(
-            t, "workers", float(N), N, block_size=block
+            t, WORKER_AXIS, float(N), N, block_size=block
         ),
         tree,
     )
     want = _run_collective(
-        mesh, lambda t: psum_mean(t, "workers", float(N)), tree
+        mesh, lambda t: psum_mean(t, WORKER_AXIS, float(N)), tree
     )
     for g, w, orig in zip(got, want, tree):
         # two quantization rounds: error <= (absmax_grad + absmax_sum)/127
@@ -90,12 +92,12 @@ def test_2round_awkward_sizes(mesh):
     got = _run_collective(
         mesh,
         lambda t: quantized_allreduce_2round(
-            t, "workers", float(N), N, block_size=128
+            t, WORKER_AXIS, float(N), N, block_size=128
         ),
         tree,
     )
     want = _run_collective(
-        mesh, lambda t: psum_mean(t, "workers", float(N)), tree
+        mesh, lambda t: psum_mean(t, WORKER_AXIS, float(N)), tree
     )
     for g, w in zip(got, want):
         assert g.shape == w.shape
@@ -120,15 +122,15 @@ def test_hier_2round_close_to_exact_mean(block, rounding):
     key = jax.random.key(0)
 
     def body(t):
-        d = jax.lax.axis_index("dcn").astype(jnp.float32)
-        w = jax.lax.axis_index("workers").astype(jnp.float32)
+        d = jax.lax.axis_index(DCN_AXIS).astype(jnp.float32)
+        w = jax.lax.axis_index(WORKER_AXIS).astype(jnp.float32)
         local = jax.tree.map(lambda g: g * (1.0 + 0.05 * (4 * d + w)), t)
         got = quantized_allreduce_2round_hier(
-            local, ("dcn", "workers"), float(N), (2, 4),
+            local, (DCN_AXIS, WORKER_AXIS), float(N), (2, 4),
             block_size=block, rounding=rounding,
             key=key if rounding == "stochastic" else None,
         )
-        want = psum_mean(local, ("dcn", "workers"), float(N))
+        want = psum_mean(local, (DCN_AXIS, WORKER_AXIS), float(N))
         return got, want
 
     got, want = jax.jit(
@@ -150,10 +152,10 @@ def test_contribution_accounting_identity(mesh, block):
     tree = _tree(2)
 
     def both(t):
-        agg = quantized_psum(t, "workers", float(N), block_size=block)
-        contrib = local_quantized_contribution(t, "workers", block_size=block)
+        agg = quantized_psum(t, WORKER_AXIS, float(N), block_size=block)
+        contrib = local_quantized_contribution(t, WORKER_AXIS, block_size=block)
         contrib_sum = jax.tree.map(
-            lambda c: jax.lax.psum(c, "workers"), contrib
+            lambda c: jax.lax.psum(c, WORKER_AXIS), contrib
         )
         return agg, contrib_sum
 
@@ -236,21 +238,21 @@ def test_ef_untracked_round2_noise_measured(mesh):
 
             grads = jax.grad(loss_fn)(params)
             agg, contrib = aggregate_gradients(
-                grads, "workers", N, compress="int8_2round",
+                grads, WORKER_AXIS, N, compress="int8_2round",
                 quant_block_size=block, return_contribution=True,
             )
             # the EF accounting's view of the aggregate: every worker's
             # round-1 transmitted value, exactly averaged (round 2 assumed
             # lossless)
             ef_view = jax.tree.map(
-                lambda c: jax.lax.psum(c, "workers") / N, contrib
+                lambda c: jax.lax.psum(c, WORKER_AXIS) / N, contrib
             )
             return agg, ef_view
 
         agg, ef_view = jax.jit(
             jax.shard_map(
                 body, mesh=mesh,
-                in_specs=(P("workers"), P("workers")),
+                in_specs=(P(WORKER_AXIS), P(WORKER_AXIS)),
                 out_specs=P(), check_vma=False,
             )
         )(images, labels)
@@ -487,4 +489,4 @@ def test_config_validation():
     # the explicit-tuple form must hit the same fence (review r03)
     with pytest.raises(ValueError, match="unsupported"):
         PSConfig(num_workers=8, compress="int8_2round",
-                 opt_placement="sharded", axis_name=("dcn", "workers"))
+                 opt_placement="sharded", axis_name=(DCN_AXIS, WORKER_AXIS))

@@ -1,0 +1,519 @@
+"""pslint (ps_pytorch_tpu/lint): one positive and one negative fixture
+per rule, pragma suppression, baseline round-trip through --format json,
+and the tier-1 repo gate: the package must be clean against the
+committed baseline, so a new hot-path hazard fails CI here.
+
+Pure-AST: no jax import happens inside the linter, so this file is fast
+(<10 s including the full-package gate).
+"""
+
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+from ps_pytorch_tpu.lint import (
+    apply_baseline,
+    lint_paths,
+    load_baseline,
+    to_baseline_json,
+)
+from ps_pytorch_tpu.lint.axes import DEFAULT_AXES
+from ps_pytorch_tpu.lint.core import lint_source
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def _lint(src: str, path: str = "snippet.py"):
+    return lint_source(src, path, DEFAULT_AXES)
+
+
+def _rules(findings):
+    return [f.rule for f in findings]
+
+
+# ------------------------------------------------------------------- PSL001
+
+PSL001_POSITIVE = """
+import jax
+from jax.sharding import PartitionSpec as P
+
+def agg(g):
+    return jax.lax.psum(g, "workers")
+
+def spec():
+    return P("wrokers")
+"""
+
+PSL001_NEGATIVE = """
+import jax
+from ps_pytorch_tpu.parallel import WORKER_AXIS
+from jax.sharding import PartitionSpec as P
+
+def agg(g):
+    return jax.lax.psum(g, WORKER_AXIS)
+
+def spec():
+    return P(WORKER_AXIS, None)
+"""
+
+
+def test_psl001_flags_literal_and_unknown_axis():
+    findings = _lint(PSL001_POSITIVE)
+    assert _rules(findings) == ["PSL001", "PSL001"]
+    assert "WORKER_AXIS" in findings[0].message  # known axis -> use constant
+    assert "unknown mesh axis 'wrokers'" in findings[1].message  # typo
+
+
+def test_psl001_constants_are_clean():
+    assert _lint(PSL001_NEGATIVE) == []
+
+
+# ------------------------------------------------------------------- PSL002
+
+PSL002_POSITIVE = """
+import jax
+
+def hot_loop(batches, f):
+    out = []
+    for b in batches:
+        step = jax.jit(f)          # jit in a loop
+        out.append(jax.jit(lambda x: x + 1)(b))  # lambda + one-shot
+    return out
+"""
+
+PSL002_NEGATIVE = """
+import jax
+
+def build(f):
+    step = jax.jit(f)
+
+    def run(batches):
+        return [step(b) for b in batches]
+
+    return run
+"""
+
+
+def test_psl002_flags_loop_lambda_and_oneshot():
+    rules = _rules(_lint(PSL002_POSITIVE))
+    # jit-in-loop (x2: both calls are inside the loop), jit-on-lambda,
+    # and jit(...)(...) one-shot in the loop
+    assert rules.count("PSL002") >= 3
+
+
+def test_psl002_hoisted_jit_is_clean():
+    assert _lint(PSL002_NEGATIVE) == []
+
+
+def test_psl002_one_shot_outside_loop_is_clean():
+    # compiling once and calling once is not a recompilation hazard —
+    # binding the callable first would change nothing
+    src = "import jax\n\ndef f(g, x):\n    return jax.jit(g)(x)\n"
+    assert _lint(src) == []
+
+
+def test_psl002_comprehensions_are_loops():
+    src = (
+        "import jax\n\ndef f(g, batches):\n"
+        "    return [jax.jit(g)(b) for b in batches]\n"
+    )
+    rules = _rules(_lint(src))
+    assert rules.count("PSL002") == 2  # jit-in-loop + per-iteration one-shot
+
+
+def test_psl002_loop_headers_and_else_run_once():
+    # a for's iterable and a loop's else-body evaluate exactly once
+    src = (
+        "import jax\n\ndef f(g, batches, x):\n"
+        "    for y in jax.jit(g)(batches):\n"
+        "        pass\n"
+        "    else:\n"
+        "        z = jax.jit(g)(x)\n"
+        "    return z\n"
+    )
+    assert _lint(src) == []
+
+
+# ------------------------------------------------------------------- PSL003
+
+PSL003_POSITIVE = """
+import time
+import numpy as np
+import jax
+
+side_channel = []
+
+@jax.jit
+def step(x):
+    print("step!", x)
+    t0 = time.time()
+    noise = np.random.randn(4)
+    side_channel.append(t0)
+    return x + noise
+"""
+
+PSL003_NEGATIVE = """
+import jax
+import jax.numpy as jnp
+
+@jax.jit
+def step(x, key):
+    acc = []
+    for i in range(4):          # static unroll of a LOCAL list is fine
+        acc.append(x * i)
+    noise = jax.random.normal(key, x.shape)
+    jax.debug.print("step {x}", x=x)
+    return sum(acc) + noise
+"""
+
+
+def test_psl003_flags_impurity_in_traced_fn():
+    rules = _rules(_lint(PSL003_POSITIVE))
+    assert rules.count("PSL003") == 4  # print, time.time, np.random, append
+
+
+def test_psl003_pure_traced_fn_is_clean():
+    assert _lint(PSL003_NEGATIVE) == []
+
+
+def test_psl003_scan_body_and_shard_map_are_traced():
+    src = """
+import jax
+
+def outer(xs):
+    def body(carry, x):
+        print(x)
+        return carry, x
+    return jax.lax.scan(body, 0, xs)
+"""
+    assert _rules(_lint(src)) == ["PSL003"]
+
+
+# ------------------------------------------------------------------- PSL004
+
+PSL004_POSITIVE = """
+import jax
+
+def train(step, batches, state):
+    for b in batches:
+        state, metrics = step(state, b)
+        m = jax.device_get(metrics)
+        loss = float(metrics["loss"])
+    return state
+"""
+
+PSL004_NEGATIVE = """
+import jax
+
+def train(step, batches, state, log_every=100):
+    for i, b in enumerate(batches):
+        state, metrics = step(state, b)
+        if i % log_every == 0:
+            metrics = jax.device_get(metrics)  # psl: sync-ok
+            print(metrics["loss"])
+    return state
+"""
+
+
+def test_psl004_flags_per_step_syncs_in_hot_module():
+    rules = _rules(_lint(PSL004_POSITIVE, path="trainer.py"))
+    assert rules == ["PSL004", "PSL004"]  # device_get + float(device value)
+
+
+def test_psl004_only_applies_to_hot_modules():
+    assert _lint(PSL004_POSITIVE, path="offline_eval.py") == []
+
+
+def test_psl004_sync_ok_pragma_suppresses():
+    assert _lint(PSL004_NEGATIVE, path="trainer.py") == []
+
+
+def test_psl004_taint_is_flow_sensitive():
+    """A periodic `metrics = jax.device_get(metrics)` behind a log guard
+    must NOT launder the per-step float() that runs BEFORE it — the taint
+    follows statement order, including the loop back-edge."""
+    src = """
+import jax
+
+def train(step, batches, state, log_every=100):
+    for i, b in enumerate(batches):
+        state, metrics = step(state, b)
+        loss = float(metrics["loss"])         # per-step sync: must flag
+        if i % log_every == 0:
+            metrics = jax.device_get(metrics)  # psl: sync-ok
+    return state
+"""
+    findings = _lint(src, path="trainer.py")
+    assert _rules(findings) == ["PSL004"]
+    assert "float()" in findings[0].message
+
+
+def test_psl004_real_trainer_is_windowed():
+    """The production trainer keeps metrics on device between log windows;
+    every intentional transfer carries the pragma."""
+    findings = [
+        f for f in lint_paths([str(REPO / "ps_pytorch_tpu" / "trainer.py")])
+        if f.rule == "PSL004"
+    ]
+    assert findings == []
+
+
+# ------------------------------------------------------------------- PSL005
+
+PSL005_POSITIVE = """
+import jax
+
+def make_train_step(f):
+    return jax.jit(f, donate_argnums=(0, 1) if True else ())
+
+def run(params, opt, tok):
+    step = make_train_step(lambda p, o, t: (p, o))
+    new_p, new_o = step(params, opt, tok)
+    return params  # donated buffer read after the call
+"""
+
+PSL005_NEGATIVE = """
+import jax
+
+def make_train_step(f):
+    return jax.jit(f, donate_argnums=(0, 1))
+
+def run(params, opt, tok, n):
+    step = make_train_step(lambda p, o, t: (p, o))
+    for _ in range(n):
+        params, opt = step(params, opt, tok)  # rebinds: safe
+    return params
+
+def run_undonated(params, opt, tok):
+    step = make_train_step(lambda p, o, t: (p, o), donate=False)
+    new_p, _ = step(params, opt, tok)
+    return params  # not donated: safe
+"""
+
+
+def test_psl005_flags_read_after_donation():
+    findings = [f for f in _lint(PSL005_POSITIVE) if f.rule == "PSL005"]
+    assert len(findings) == 1
+    assert "'params' read after being donated" in findings[0].message
+
+
+def test_psl005_rebind_and_opt_out_are_clean():
+    assert [f for f in _lint(PSL005_NEGATIVE) if f.rule == "PSL005"] == []
+
+
+def test_psl005_loop_carries_donation_to_next_iteration():
+    src = """
+import jax
+
+def make_train_step(f):
+    return jax.jit(f, donate_argnums=(0,))
+
+def run(state, batches):
+    step = make_train_step(lambda s, b: s)
+    for b in batches:
+        new_state = step(state, b)  # `state` donated on iter 1, read on iter 2
+    return new_state
+"""
+    findings = [f for f in _lint(src) if f.rule == "PSL005"]
+    assert len(findings) >= 1
+
+
+def test_psl005_factories_discovered_across_files(tmp_path):
+    """A factory in one file, the unsafe call site in another: lint_paths
+    links them (this is how tests calling parallel/ factories are checked)."""
+    (tmp_path / "maker.py").write_text(
+        "import jax\n"
+        "def make_step(f):\n"
+        "    return jax.jit(f, donate_argnums=(0,))\n"
+    )
+    (tmp_path / "caller.py").write_text(
+        "from maker import make_step\n"
+        "def go(state, b):\n"
+        "    step = make_step(lambda s, b: s)\n"
+        "    out = step(state, b)\n"
+        "    return state\n"
+    )
+    findings = lint_paths([str(tmp_path)])
+    assert [f.rule for f in findings] == ["PSL005"]
+
+
+# ------------------------------------------------------------- pragmas / CLI
+
+def test_blanket_ignore_pragma():
+    src = 'import jax\n\ndef f(g):\n    return jax.lax.psum(g, "workers")  # psl: ignore\n'
+    assert _lint(src) == []
+
+
+def test_rule_scoped_ignore_pragma():
+    src = (
+        'import jax\n\ndef f(g):\n'
+        '    return jax.lax.psum(g, "workers")  # psl: ignore[PSL001]\n'
+    )
+    assert _lint(src) == []
+    src_wrong_rule = src.replace("PSL001", "PSL002")
+    assert _rules(_lint(src_wrong_rule)) == ["PSL001"]
+
+
+def test_rule_scoped_ignore_tolerates_spaced_bracket():
+    """'# psl: ignore [PSL002]' must scope to PSL002 — never degrade to a
+    blanket ignore because of the space before the bracket."""
+    src = (
+        'import jax\n\ndef f(g):\n'
+        '    return jax.lax.psum(g, "workers")  # psl: ignore [PSL002]\n'
+    )
+    assert _rules(_lint(src)) == ["PSL001"]  # PSL001 still reported
+
+
+def test_psl004_flags_while_test_sync():
+    """A while-test re-runs every iteration: a host sync there is a
+    per-step sync even at the top level of a function."""
+    src = """
+import jax
+
+def train(step, state, b, metrics):
+    while float(metrics["loss"]) > 0.1:
+        state, metrics = step(state, b)
+    return state
+"""
+    assert _rules(_lint(src, path="trainer.py")) == ["PSL004"]
+
+
+def test_pragma_covers_multiline_statement():
+    """A pragma after the closing paren of a formatter-wrapped call still
+    suppresses a finding anchored to the call's first line."""
+    src = (
+        "import jax\n\ndef f(g):\n"
+        "    return jax.lax.psum(\n"
+        "        g,\n"
+        '        "workers",\n'
+        "    )  # psl: ignore[PSL001]\n"
+    )
+    assert _lint(src) == []
+
+
+def test_pragma_in_string_is_not_a_pragma():
+    src = (
+        'import jax\n\ndef f(g):\n'
+        '    s = " # psl: ignore"\n'
+        '    return jax.lax.psum(g, "workers"), s\n'
+    )
+    assert _rules(_lint(src)) == ["PSL001"]
+
+
+def test_cli_rejects_missing_path_and_select_write_combo(tmp_path):
+    """A mistyped path must be a usage error (exit 2), never a clean exit
+    that lints nothing; --select + --write-baseline would silently drop
+    baseline entries for unselected rules."""
+    cmd = [sys.executable, "-m", "ps_pytorch_tpu.lint"]
+    bad = subprocess.run(cmd + ["no_such_dir_xyz"], capture_output=True,
+                         text=True, cwd=str(REPO))
+    assert bad.returncode == 2
+    assert "no such file" in bad.stderr
+    combo = subprocess.run(
+        cmd + ["ps_pytorch_tpu", "--select", "PSL001", "--write-baseline",
+               "--baseline", str(tmp_path / "b.json")],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert combo.returncode == 2
+    assert not (tmp_path / "b.json").exists()
+    notpy = subprocess.run(cmd + ["tools/lint.sh"], capture_output=True,
+                           text=True, cwd=str(REPO))
+    assert notpy.returncode == 2
+    assert "not a python file" in notpy.stderr
+
+
+def test_syntax_error_reported_as_psl000(tmp_path):
+    bad = tmp_path / "bad.py"
+    bad.write_text("def broken(:\n")
+    findings = lint_paths([str(bad)])
+    assert _rules(findings) == ["PSL000"]
+
+
+# ------------------------------------------------------- baseline round-trip
+
+def test_baseline_round_trips_through_json(tmp_path):
+    """--format json output's `findings` array IS a valid baseline: feeding
+    it back makes the same run exit 0 with everything baselined."""
+    snippet = tmp_path / "hot.py"
+    snippet.write_text(
+        'import jax\n\ndef f(g):\n    return jax.lax.psum(g, "workers")\n'
+    )
+    env_cmd = [sys.executable, "-m", "ps_pytorch_tpu.lint", str(snippet)]
+    first = subprocess.run(
+        env_cmd + ["--format", "json", "--no-baseline"],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert first.returncode == 1
+    payload = json.loads(first.stdout)
+    assert [f["rule"] for f in payload["new"]] == ["PSL001"]
+
+    baseline_file = tmp_path / "baseline.json"
+    baseline_file.write_text(json.dumps(payload))  # findings key reused as-is
+    second = subprocess.run(
+        env_cmd + ["--baseline", str(baseline_file)],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert second.returncode == 0, second.stdout + second.stderr
+    assert "1 baselined" in second.stdout
+
+
+def test_baseline_matches_on_text_not_line_numbers():
+    from ps_pytorch_tpu.lint import Finding
+
+    current = [Finding("PSL001", "a.py", 42, 0, "msg", 'psum(g, "workers")')]
+    moved = [Finding("PSL001", "a.py", 99, 0, "msg", 'psum(g, "workers")')]
+    new, matched, stale = apply_baseline(current, moved)
+    assert new == [] and len(matched) == 1 and stale == []
+
+
+def test_stale_baseline_entries_are_reported():
+    from ps_pytorch_tpu.lint import Finding
+
+    baseline = [Finding("PSL001", "a.py", 1, 0, "msg", "gone_line")]
+    new, matched, stale = apply_baseline([], baseline)
+    assert new == [] and matched == [] and len(stale) == 1
+
+
+def test_to_baseline_and_load_round_trip(tmp_path):
+    from ps_pytorch_tpu.lint import Finding
+
+    f = Finding("PSL002", "b.py", 7, 3, "m", "jax.jit(lambda x: x)")
+    p = tmp_path / "b.json"
+    p.write_text(json.dumps(to_baseline_json([f])))
+    assert load_baseline(str(p)) == [f]
+
+
+# ------------------------------------------------------------ tier-1 gate
+
+def test_package_is_clean_against_committed_baseline():
+    """THE CI gate: linting ps_pytorch_tpu/ AND tests/ must produce zero
+    findings beyond lint_baseline.json. tests/ is included because that is
+    where donated-buffer reuse (PSL005) lives — donation is only a warning
+    on the CPU mesh CI runs on, so the static check is the only guard."""
+    findings = lint_paths([str(REPO / "ps_pytorch_tpu"), str(REPO / "tests")])
+    baseline = load_baseline(str(REPO / "lint_baseline.json"))
+    # paths in the baseline are repo-relative; findings here are absolute
+    rel = [
+        f.__class__(
+            f.rule, str(Path(f.path).resolve().relative_to(REPO)),
+            f.line, f.col, f.message, f.text,
+        )
+        for f in findings
+    ]
+    new, _, _ = apply_baseline(rel, baseline)
+    assert new == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule} {f.message}" for f in new
+    )
+
+
+def test_cli_exit_zero_on_package(tmp_path):
+    """End-to-end: the exact command CI runs (tools/lint.sh)."""
+    proc = subprocess.run(
+        [sys.executable, "-m", "ps_pytorch_tpu.lint", "ps_pytorch_tpu",
+         "tests", "--baseline", "lint_baseline.json"],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr

@@ -22,6 +22,7 @@ from ps_pytorch_tpu.models import apply_model, build_model, init_model
 from ps_pytorch_tpu.ops.metrics import cross_entropy_loss
 from ps_pytorch_tpu.optim import sgd
 from ps_pytorch_tpu.parallel import (
+    WORKER_AXIS,
     PSConfig,
     aggregate_gradients,
     init_ps_state,
@@ -40,7 +41,9 @@ def _lenet_setup(cfg, mesh, lr=0.1, momentum=0.0):
     tx = sgd(lr, momentum=momentum)
     state = init_ps_state(model, tx, cfg, jax.random.key(0), (28, 28, 1))
     state = shard_state(state, mesh, cfg)
-    step = make_ps_train_step(model, tx, cfg, mesh, donate=False)
+    # donate=True (the production default): PSL005 guards the tests below
+    # against reading `state` after it has been handed to the step
+    step = make_ps_train_step(model, tx, cfg, mesh)
     return model, tx, state, step
 
 
@@ -57,10 +60,9 @@ def test_dp_step_matches_single_device(mesh):
     model, tx, state, step = _lenet_setup(cfg, mesh)
     batch = _batch(16)
     sharded = shard_batch(batch, mesh, cfg)
-    new_state, metrics = step(state, sharded, jax.random.key(1))
-
-    # single-device reference on the identical global batch
+    # snapshot params BEFORE the step: the step donates its input state
     params0 = jax.device_get(state.params)
+    new_state, metrics = step(state, sharded, jax.random.key(1))
     x = jnp.asarray(batch["image"], jnp.float32)
     y = jnp.asarray(batch["label"])
 
@@ -92,7 +94,7 @@ def _per_worker_grads_via_shardmap(mesh, fn):
     """Run fn(worker_value) under shard_map where worker w's input is w."""
     vals = jnp.arange(N, dtype=jnp.float32).reshape(N, 1)
     mapped = jax.shard_map(
-        fn, mesh=mesh, in_specs=(P("workers"),), out_specs=P(), check_vma=False
+        fn, mesh=mesh, in_specs=(P(WORKER_AXIS),), out_specs=P(), check_vma=False
     )
     return mapped(vals)
 
@@ -101,7 +103,7 @@ def test_aggregation_first_k(mesh):
     def fn(v):
         g = {"w": v[0]}  # worker w contributes value w
         agg = aggregate_gradients(
-            g, "workers", N, num_aggregate=2, mask_mode="first_k"
+            g, WORKER_AXIS, N, num_aggregate=2, mask_mode="first_k"
         )
         return agg["w"]
 
@@ -113,7 +115,7 @@ def test_aggregation_random_k_counts(mesh):
     def fn(v):
         g = {"w": jnp.ones_like(v[0])}
         agg = aggregate_gradients(
-            g, "workers", N, num_aggregate=3, mask_key=jax.random.key(5),
+            g, WORKER_AXIS, N, num_aggregate=3, mask_key=jax.random.key(5),
             mask_mode="random_k",
         )
         return agg["w"]
@@ -126,8 +128,8 @@ def test_aggregation_random_k_counts(mesh):
 def test_aggregation_int8_close_to_exact(mesh):
     def fn(v):
         g = {"w": v[0] * jnp.linspace(0.1, 1.0, 128)}
-        exact = aggregate_gradients(dict(g), "workers", N)
-        quant = aggregate_gradients(dict(g), "workers", N, compress="int8")
+        exact = aggregate_gradients(dict(g), WORKER_AXIS, N)
+        quant = aggregate_gradients(dict(g), WORKER_AXIS, N, compress="int8")
         return jnp.max(jnp.abs(exact["w"] - quant["w"]))
 
     err = float(_per_worker_grads_via_shardmap(mesh, fn))
@@ -160,10 +162,11 @@ def test_sharded_with_int8_and_mask_runs(mesh):
         num_aggregate=5,
     )
     model, tx, state, step = _lenet_setup(cfg, mesh)
+    # read BEFORE the step donates `state`
+    a0 = jax.tree_util.tree_leaves(jax.device_get(state.params))[0]
     state2, metrics = step(state, shard_batch(_batch(), mesh, cfg), jax.random.key(2))
     assert np.isfinite(float(metrics["loss"]))
     # params actually changed
-    a0 = jax.tree_util.tree_leaves(jax.device_get(state.params))[0]
     a1 = jax.tree_util.tree_leaves(jax.device_get(state2.params))[0]
     assert not np.allclose(a0, a1)
 
@@ -176,7 +179,7 @@ def test_local_bn_mode_keeps_per_worker_stats(mesh):
     leaves = jax.tree_util.tree_leaves(state.batch_stats)
     assert all(l.shape[0] == N for l in leaves)
     state = shard_state(state, mesh, cfg)
-    step = make_ps_train_step(model, tx, cfg, mesh, donate=False)
+    step = make_ps_train_step(model, tx, cfg, mesh)
     rng = np.random.RandomState(0)
     batch = {
         "image": rng.randint(0, 255, (16, 32, 32, 3)).astype(np.uint8),
@@ -200,7 +203,7 @@ def test_convergence_smoke(mesh):
     state = init_ps_state(model, tx, cfg, jax.random.key(0), (28, 28, 1))
     state = shard_state(state, mesh, cfg)
     pre = make_preprocessor("MNIST", train=True)
-    step = make_ps_train_step(model, tx, cfg, mesh, preprocess=pre, donate=False)
+    step = make_ps_train_step(model, tx, cfg, mesh, preprocess=pre)
     it = BatchIterator(ds.train_images, ds.train_labels, batch_size=64, seed=0)
     losses = []
     for i, b in enumerate(it.forever()):
@@ -232,9 +235,9 @@ def test_stochastic_quantized_step_runs(mesh):
         quant_block_size=128,
     )
     model, tx, state, step = _lenet_setup(cfg, mesh)
+    a0 = jax.tree_util.tree_leaves(jax.device_get(state.params))[0]
     state2, metrics = step(state, shard_batch(_batch(), mesh, cfg), jax.random.key(3))
     assert np.isfinite(float(metrics["loss"]))
-    a0 = jax.tree_util.tree_leaves(jax.device_get(state.params))[0]
     a1 = jax.tree_util.tree_leaves(jax.device_get(state2.params))[0]
     assert not np.allclose(a0, a1)
 
@@ -269,7 +272,7 @@ def test_grad_accum_matches_single_shot(mesh):
         cfg = PSConfig(num_workers=8, grad_accum_steps=a)
         state = init_ps_state(model, tx, cfg, jax.random.key(0), (28, 28, 1))
         state = shard_state(state, mesh, cfg)
-        step = make_ps_train_step(model, tx, cfg, mesh, donate=False)
+        step = make_ps_train_step(model, tx, cfg, mesh)
         new_state, m = step(state, shard_batch(batch, mesh, cfg), key)
         results[a] = (jax.device_get(new_state.params), float(m["loss"]),
                       float(m["prec1"]))

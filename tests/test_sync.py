@@ -38,6 +38,7 @@ def test_host_sync_serializes_pending_work():
     # after host_sync returns, the computation's result must be readable
     # with no further device work (smoke: value is correct)
     x = jnp.ones((64, 64))
-    y = jax.jit(lambda a: a @ a)(x)
+    square = jax.jit(jnp.matmul)
+    y = square(x, x)
     host_sync(y)
     assert float(y[0, 0]) == 64.0
