@@ -1,0 +1,62 @@
+"""Version compatibility gates for the pinned jax in this image.
+
+The engine is written against the modern `jax.shard_map(..., check_vma=)`
+API; the image pins jax 0.4.37, where shard_map still lives in
+`jax.experimental.shard_map` and the replication-checking knob is called
+`check_rep`. Installing the alias here (imported from the package
+__init__, so every entry point gets it before any step factory runs)
+keeps the production modules written against the current API while the
+pinned interpreter still works — the same stub-don't-vendor rule the
+Pallas kernels follow for interpret mode.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+
+
+def _install_shard_map_alias() -> None:
+    if hasattr(jax, "shard_map"):
+        return
+
+    from jax.experimental.shard_map import shard_map as _legacy_shard_map
+
+    @functools.wraps(_legacy_shard_map)
+    def shard_map(f=None, *, mesh, in_specs, out_specs, check_vma=True,
+                  **kwargs):
+        if f is None:
+            return functools.partial(
+                shard_map, mesh=mesh, in_specs=in_specs,
+                out_specs=out_specs, check_vma=check_vma, **kwargs,
+            )
+        return _legacy_shard_map(
+            f, mesh=mesh, in_specs=in_specs, out_specs=out_specs,
+            check_rep=check_vma, **kwargs,
+        )
+
+    jax.shard_map = shard_map
+
+
+def _install_axis_size_alias() -> None:
+    from jax import lax
+
+    if hasattr(lax, "axis_size"):
+        return
+
+    from jax._src import core as _core
+
+    def axis_size(axis_name):
+        if isinstance(axis_name, (tuple, list)):
+            size = 1
+            for a in axis_name:
+                size *= _core.axis_frame(a)
+            return size
+        return _core.axis_frame(axis_name)
+
+    lax.axis_size = axis_size
+
+
+_install_shard_map_alias()
+_install_axis_size_alias()

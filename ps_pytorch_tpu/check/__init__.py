@@ -1,0 +1,58 @@
+"""pscheck — jaxpr-level contract checking for the parallel schemes.
+
+pslint (ps_pytorch_tpu/lint) guards the SOURCE TEXT; pscheck guards what
+XLA is actually asked to do: it traces each scheme's real step function
+(CPU-only, abstract inputs, nothing executes) and walks the jaxpr to
+verify the communication contracts ARCHITECTURE §1-§6b claim — every
+axis carries its collective (PSC101), gradient reductions feed the
+optimizer (PSC102), compressed wires stay int8 (PSC103), per-collective
+wire bytes round-trip against runs/comm_contract.json (PSC104), and
+donation survives lowering (PSC105).
+
+Entry points: ``python -m ps_pytorch_tpu.check``, ``tools/check.sh``,
+and the tier-1 gate in tests/test_check.py.
+"""
+
+from .contracts import (
+    Built,
+    ContractSpec,
+    DonationSpec,
+    GradReduce,
+    WireAllowance,
+    WirePolicy,
+    get_contracts,
+)
+from .core import (
+    CheckFinding,
+    TraceResult,
+    load_contract,
+    run_checks,
+    to_contract_json,
+    trace_registry,
+    trace_spec,
+    write_contract,
+)
+from .rules import RULE_IDS
+from .walker import Collective, collect_collectives, summarize
+
+__all__ = [
+    "Built",
+    "CheckFinding",
+    "Collective",
+    "ContractSpec",
+    "DonationSpec",
+    "GradReduce",
+    "RULE_IDS",
+    "TraceResult",
+    "WireAllowance",
+    "WirePolicy",
+    "collect_collectives",
+    "get_contracts",
+    "load_contract",
+    "run_checks",
+    "summarize",
+    "to_contract_json",
+    "trace_registry",
+    "trace_spec",
+    "write_contract",
+]

@@ -1,0 +1,174 @@
+"""CLI: ``python -m ps_pytorch_tpu.check [options]``.
+
+Exit codes mirror pslint: 0 = every contract holds, 1 = findings,
+2 = usage error. ``--write-contract`` regenerates the committed
+accounting artifact (runs/comm_contract.json) from the current registry
+and exits 0 — the PSC101/102/103/105 rules still run first, so a broken
+step cannot silently re-baseline itself.
+
+Tracing needs a deterministic 8-device CPU backend; when launched as a
+real CLI in the ambient (broken-TPU-plugin) environment the process
+re-execs itself under the tpu_env scrub first, exactly like the test
+suite's root conftest. Programmatic callers (tests) are already clean
+and skip the re-exec.
+"""
+
+from __future__ import annotations
+
+import argparse
+import importlib
+import json
+import os
+import sys
+
+
+def _reexec_clean_env() -> None:
+    """Re-exec under the CPU scrub if the ambient env would hang jax."""
+    repo = os.path.dirname(
+        os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    )
+    if repo not in sys.path:
+        sys.path.insert(0, repo)
+    try:
+        from tpu_env import clean_cpu_env, env_is_clean
+    except ImportError:
+        return  # installed outside the repo: trust the caller's env
+    from .contracts import MESH_DEVICES
+
+    if env_is_clean(n_devices=MESH_DEVICES):
+        return
+    os.execve(
+        sys.executable,
+        [sys.executable, "-m", "ps_pytorch_tpu.check", *sys.argv[1:]],
+        clean_cpu_env(n_devices=MESH_DEVICES),
+    )
+
+
+def _load_registry(module_name: str):
+    mod = importlib.import_module(module_name)
+    get = getattr(mod, "get_contracts", None)
+    if get is None:
+        raise AttributeError(
+            f"registry module {module_name!r} defines no get_contracts()"
+        )
+    return list(get())
+
+
+def main(argv=None) -> int:
+    from .core import DEFAULT_CONTRACT
+
+    parser = argparse.ArgumentParser(
+        prog="python -m ps_pytorch_tpu.check",
+        description="jaxpr-level contract checker (rules PSC101-PSC105).",
+    )
+    parser.add_argument("--format", choices=("text", "json"),
+                        default="text")
+    parser.add_argument("--contract", default=None,
+                        help=f"accounting artifact (default: "
+                             f"./{DEFAULT_CONTRACT} if present)")
+    parser.add_argument("--write-contract", action="store_true",
+                        help="regenerate the accounting artifact from the "
+                             "current registry and exit 0 (PSC101/102/103/"
+                             "105 still run)")
+    parser.add_argument("--registry",
+                        default="ps_pytorch_tpu.check.contracts",
+                        help="module exposing get_contracts() "
+                             "(default: the committed registry)")
+    parser.add_argument("--only", default=None,
+                        help="comma-separated config names to trace "
+                             "(PSC104 stale-entry checking is skipped)")
+    parser.add_argument("--list", action="store_true",
+                        help="list registry config names and exit")
+    args = parser.parse_args(argv)
+
+    if args.write_contract and args.only:
+        print(
+            "pscheck: --write-contract cannot be combined with --only "
+            "(a partial write would drop the other configs' pinned rows)",
+            file=sys.stderr,
+        )
+        return 2
+
+    try:
+        specs = _load_registry(args.registry)
+    except (ImportError, AttributeError) as e:
+        print(f"pscheck: cannot load registry: {e}", file=sys.stderr)
+        return 2
+
+    names = [s.name for s in specs]
+    if args.list:
+        print("\n".join(names))
+        return 0
+
+    only = None
+    if args.only:
+        only = [n.strip() for n in args.only.split(",") if n.strip()]
+        unknown = sorted(set(only) - set(names))
+        if unknown:
+            print(f"pscheck: unknown config(s): {', '.join(unknown)}",
+                  file=sys.stderr)
+            return 2
+
+    from .core import (
+        load_contract,
+        render_text,
+        run_checks,
+        trace_registry,
+        write_contract,
+    )
+
+    results = trace_registry(specs, only=only)
+
+    if args.write_contract:
+        findings = run_checks(results, contract=None)
+        path = args.contract or DEFAULT_CONTRACT
+        if os.path.dirname(path):
+            os.makedirs(os.path.dirname(path), exist_ok=True)
+        write_contract(path, results)
+        print(f"pscheck: wrote {len(results)} config(s) to {path}")
+        if findings:
+            print(render_text(findings, len(results)))
+            print(
+                "pscheck: WARNING: the artifact was written but "
+                f"{len(findings)} non-PSC104 finding(s) remain — the "
+                "contract rules above still fail",
+                file=sys.stderr,
+            )
+            return 1
+        return 0
+
+    contract_path = args.contract or (
+        DEFAULT_CONTRACT if os.path.exists(DEFAULT_CONTRACT) else None
+    )
+    contract = None
+    if contract_path:
+        try:
+            contract = load_contract(contract_path)
+        except (OSError, ValueError, KeyError) as e:
+            print(f"pscheck: cannot read contract {contract_path}: {e}",
+                  file=sys.stderr)
+            return 2
+    findings = run_checks(results, contract, check_stale=only is None)
+
+    if args.format == "json":
+        print(json.dumps(
+            {
+                "version": 1,
+                "tool": "pscheck",
+                "configs": [r.spec.name for r in results],
+                "findings": [f.to_json() for f in findings],
+                "collectives": {
+                    r.spec.name: r.summary for r in results
+                },
+            },
+            indent=2,
+            sort_keys=True,
+        ))
+    else:
+        print(render_text(findings, len(results)))
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    _reexec_clean_env()
+    sys.exit(main())

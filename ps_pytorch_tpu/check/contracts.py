@@ -1,0 +1,389 @@
+"""pscheck contract registry: each scheme's step function + its declared
+communication invariants.
+
+A ContractSpec bundles a builder that constructs the REAL production step
+(the same factory the trainer calls — nothing re-implemented here) with
+the invariants ARCHITECTURE.md claims for it, as data the rules
+(rules.py) can verify against the traced jaxpr:
+
+- ``axes``: every declared mesh axis must be consumed by a collective,
+  and no collective may ride any other axis (PSC101);
+- ``grad_reduce``: for each axis across which gradient leaves are
+  replicated, the reducing collective kinds that must feed the updated
+  params (PSC102) — ``psum`` for the plain/int8 paths, ``psum_scatter``
+  for the ZeRO-1 wire, ``all_to_all`` for the bandwidth-honest 2-round
+  schemes (where the all_to_all + local sum IS the reduction);
+- ``wire``: for configs that claim an int8 wire (§6b ladder rung 3), the
+  payload dtype every collective on those axes must carry, plus the
+  explicitly-allowed exceptions — scale rows, the f32 metrics pmean, the
+  ZeRO-1 update all_gather (the weight bcast analogue) (PSC103);
+- ``donation``: which args the compiled step donates and which outputs
+  they must alias (PSC105).
+
+Builders run CPU-only and deterministic: states are jax.eval_shape
+abstractions, inputs are ShapeDtypeStructs — tracing never allocates or
+executes a step.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Callable, Optional, Tuple
+
+MESH_DEVICES = 8  # the virtual CPU mesh every contract traces on
+
+
+@dataclasses.dataclass(frozen=True)
+class GradReduce:
+    """PSC102: a reduce over `axis` with one of `kinds` must feed params."""
+
+    axis: str
+    kinds: Tuple[str, ...]
+
+
+@dataclasses.dataclass(frozen=True)
+class WireAllowance:
+    """A declared non-payload-dtype collective on a compressed wire."""
+
+    kind: str
+    dtype: str
+    reason: str
+    max_bytes: Optional[int] = None   # None = unlimited (document why!)
+    axes: Optional[Tuple[str, ...]] = None  # None = any axes
+
+
+@dataclasses.dataclass(frozen=True)
+class WirePolicy:
+    """PSC103: collectives riding `axes` must carry `payload_dtype`
+    unless a WireAllowance explicitly covers them."""
+
+    axes: Tuple[str, ...]
+    payload_dtype: str = "int8"
+    allow: Tuple[WireAllowance, ...] = ()
+
+
+@dataclasses.dataclass(frozen=True)
+class DonationSpec:
+    """PSC105: arg `argnums[i]` is donated and must alias output
+    position `out_positions[i]` of the step's output tuple."""
+
+    argnums: Tuple[int, ...]
+    out_positions: Tuple[int, ...]
+
+
+@dataclasses.dataclass
+class Built:
+    """What a spec's builder returns: the real jitted step plus abstract
+    example args and a selector for the updated-params subtree."""
+
+    step: Callable
+    args: Tuple[Any, ...]
+    select_params: Callable[[Any], Any]
+
+
+@dataclasses.dataclass
+class ContractSpec:
+    name: str
+    build: Callable[[], Built]
+    axes: Tuple[str, ...]
+    grad_reduce: Tuple[GradReduce, ...] = ()
+    wire: Optional[WirePolicy] = None
+    donation: Optional[DonationSpec] = None
+
+
+# metrics / loss pmean: a handful of f32 scalars, every scheme emits it
+_METRICS_PSUM = WireAllowance(
+    kind="psum", dtype="float32", max_bytes=64,
+    reason="metrics/loss pmean (scalars)",
+)
+# shared-scale agreement for round-1 quantization (ops/quantize pmax)
+_SCALE_PMAX = WireAllowance(
+    kind="pmax", dtype="float32", max_bytes=4096,
+    reason="per-tensor/per-block scale agreement (pmax)",
+)
+# round-2 scale rows ride an f32 all_gather next to the int8 payload
+_SCALE_GATHER = WireAllowance(
+    kind="all_gather", dtype="float32", max_bytes=4096,
+    reason="round-2 quantization scale rows",
+)
+
+
+def _lenet_ps_built(cfg) -> Built:
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from ..models import build_model
+    from ..parallel.mesh import make_hybrid_mesh, make_mesh
+    from ..parallel.ps import init_ps_state, make_ps_train_step
+
+    model = build_model("LeNet", num_classes=10)
+    tx = optax.sgd(0.1)
+    if cfg.dcn_hosts > 1:
+        mesh = make_hybrid_mesh(cfg.dcn_hosts, cfg.num_workers // cfg.dcn_hosts)
+    else:
+        mesh = make_mesh(num_workers=cfg.num_workers)
+    step = make_ps_train_step(model, tx, cfg, mesh, donate=True)
+    state = jax.eval_shape(
+        lambda: init_ps_state(model, tx, cfg, jax.random.key(0), (1, 28, 28, 1))
+    )
+    batch = {
+        "image": jax.ShapeDtypeStruct(
+            (cfg.num_workers, 28, 28, 1), jnp.uint8
+        ),
+        "label": jax.ShapeDtypeStruct((cfg.num_workers,), jnp.int32),
+    }
+    key = jax.eval_shape(lambda: jax.random.key(0))
+    return Built(
+        step=step,
+        args=(state, batch, key),
+        select_params=lambda out: out[0].params,
+    )
+
+
+def _ps_spec(compress, placement, dcn_hosts: int = 1) -> ContractSpec:
+    from ..parallel.mesh import DCN_AXIS, WORKER_AXIS
+
+    name = "ps_{}_{}".format(compress or "none", placement)
+    if dcn_hosts > 1:
+        name = "ps_hier_{}_{}".format(compress, placement)
+    axes: Tuple[str, ...] = (
+        (DCN_AXIS, WORKER_AXIS) if dcn_hosts > 1 else (WORKER_AXIS,)
+    )
+
+    def build() -> Built:
+        from ..parallel.ps import PSConfig
+
+        return _lenet_ps_built(
+            PSConfig(
+                num_workers=MESH_DEVICES,
+                compress=compress,
+                opt_placement=placement,
+                dcn_hosts=dcn_hosts,
+            )
+        )
+
+    # the reduce that must feed the optimizer, per §6b ladder rung:
+    # lossless/int8 reduce with a psum (psum_scatter when ZeRO-1 sharded);
+    # the 2-round schemes reduce via all_to_all + local sum
+    if compress == "int8_2round":
+        reduce_kinds: Tuple[str, ...] = ("all_to_all",)
+    elif placement == "sharded":
+        reduce_kinds = ("psum_scatter",)
+    else:
+        reduce_kinds = ("psum",)
+    grad_reduce = tuple(GradReduce(a, reduce_kinds) for a in axes)
+
+    wire = None
+    if compress == "int8_2round":
+        allow = [_METRICS_PSUM, _SCALE_PMAX, _SCALE_GATHER]
+        if placement == "sharded":
+            allow.append(
+                WireAllowance(
+                    kind="all_gather", dtype="float32", max_bytes=None,
+                    reason="ZeRO-1 f32 update all_gather (the weight "
+                           "bcast analogue; §6b sharded placement)",
+                )
+            )
+        if dcn_hosts > 1:
+            allow.append(
+                WireAllowance(
+                    kind="all_gather", dtype="float32", max_bytes=None,
+                    axes=(WORKER_AXIS,),
+                    reason="hierarchical reassembly all_gather rides ICI "
+                           "only (§6b: spend bytes on the link that has "
+                           "them)",
+                )
+            )
+        wire = WirePolicy(axes=axes, payload_dtype="int8",
+                          allow=tuple(allow))
+
+    return ContractSpec(
+        name=name,
+        build=build,
+        axes=axes,
+        grad_reduce=grad_reduce,
+        wire=wire,
+        donation=DonationSpec(argnums=(0,), out_positions=(0,)),
+    )
+
+
+def _lm_cfg():
+    from ..models.transformer import TransformerConfig
+
+    return TransformerConfig(
+        vocab_size=32, dim=16, depth=2, heads=4, max_seq_len=16
+    )
+
+
+def _dp_tp_spec() -> ContractSpec:
+    from ..parallel.mesh import WORKER_AXIS
+    from ..parallel.tp import TP_AXIS
+
+    def build() -> Built:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ..parallel.dp_tp import make_dp_tp_train_step, make_mesh_dp_tp
+        from ..parallel.tp import _tp_param_shapes
+
+        cfg = _lm_cfg()
+        tx = optax.sgd(0.1)
+        mesh = make_mesh_dp_tp(4, 2)
+        step = make_dp_tp_train_step(cfg, tx, mesh, donate=True)
+        params = _tp_param_shapes(cfg)
+        opt = jax.eval_shape(tx.init, params)
+        toks = jax.ShapeDtypeStruct((8, 16), jnp.int32)
+        return Built(
+            step=step,
+            args=(params, opt, toks),
+            select_params=lambda out: out[0],
+        )
+
+    return ContractSpec(
+        name="dp_tp",
+        build=build,
+        axes=(WORKER_AXIS, TP_AXIS),
+        grad_reduce=(
+            GradReduce(WORKER_AXIS, ("psum",)),
+            GradReduce(TP_AXIS, ("psum",)),
+        ),
+        donation=DonationSpec(argnums=(0, 1), out_positions=(0, 1)),
+    )
+
+
+def _pp_spec() -> ContractSpec:
+    from ..parallel.pp import PP_AXIS
+
+    def build() -> Built:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ..parallel.pp import (
+            _pp_param_shapes,
+            make_pp_mesh,
+            make_pp_train_step,
+        )
+
+        cfg = _lm_cfg()
+        tx = optax.sgd(0.1)
+        mesh = make_pp_mesh(2)
+        step = make_pp_train_step(cfg, tx, mesh, num_microbatches=2,
+                                  donate=True)
+        params = _pp_param_shapes(cfg)
+        opt = jax.eval_shape(tx.init, params)
+        toks = jax.ShapeDtypeStruct((4, 16), jnp.int32)
+        return Built(
+            step=step,
+            args=(params, opt, toks),
+            select_params=lambda out: out[0],
+        )
+
+    return ContractSpec(
+        name="pp",
+        build=build,
+        axes=(PP_AXIS,),
+        grad_reduce=(GradReduce(PP_AXIS, ("psum",)),),
+        donation=DonationSpec(argnums=(0, 1), out_positions=(0, 1)),
+    )
+
+
+def _moe_spec() -> ContractSpec:
+    from ..parallel.moe import EP_AXIS
+
+    def build() -> Built:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ..parallel.moe import (
+            MoEConfig,
+            _moe_param_shapes,
+            make_ep_mesh,
+            make_moe_train_step,
+        )
+
+        cfg = _lm_cfg()
+        moe = MoEConfig(num_experts=MESH_DEVICES)
+        tx = optax.sgd(0.1)
+        mesh = make_ep_mesh(MESH_DEVICES)
+        step = make_moe_train_step(cfg, moe, tx, mesh, donate=True)
+        params = _moe_param_shapes(cfg, moe)
+        opt = jax.eval_shape(tx.init, params)
+        toks = jax.ShapeDtypeStruct((8, 16), jnp.int32)
+        return Built(
+            step=step,
+            args=(params, opt, toks),
+            select_params=lambda out: out[0],
+        )
+
+    return ContractSpec(
+        name="moe",
+        build=build,
+        axes=(EP_AXIS,),
+        grad_reduce=(GradReduce(EP_AXIS, ("psum",)),),
+        donation=DonationSpec(argnums=(0, 1), out_positions=(0, 1)),
+    )
+
+
+def _dp_tp_pp_spec() -> ContractSpec:
+    from ..parallel.dp_tp_pp import DP_AXIS
+    from ..parallel.pp import PP_AXIS
+    from ..parallel.tp import TP_AXIS
+
+    def build() -> Built:
+        import jax
+        import jax.numpy as jnp
+        import optax
+
+        from ..models.transformer import init_transformer
+        from ..parallel.dp_tp_pp import (
+            make_3d_train_step,
+            make_mesh_3d,
+            to_3d_layout,
+        )
+
+        cfg = _lm_cfg()
+        tx = optax.sgd(0.1)
+        mesh = make_mesh_3d(2, 2, 2)
+        step = make_3d_train_step(cfg, tx, mesh, num_microbatches=2,
+                                  donate=True)
+        params = jax.eval_shape(
+            lambda: to_3d_layout(cfg, init_transformer(cfg, jax.random.key(0)))
+        )
+        opt = jax.eval_shape(tx.init, params)
+        toks = jax.ShapeDtypeStruct((4, 16), jnp.int32)
+        return Built(
+            step=step,
+            args=(params, opt, toks),
+            select_params=lambda out: out[0],
+        )
+
+    return ContractSpec(
+        name="dp_tp_pp",
+        build=build,
+        axes=(DP_AXIS, PP_AXIS, TP_AXIS),
+        grad_reduce=(
+            GradReduce(DP_AXIS, ("psum",)),
+            GradReduce(PP_AXIS, ("psum",)),
+            GradReduce(TP_AXIS, ("psum",)),
+        ),
+        donation=DonationSpec(argnums=(0, 1), out_positions=(0, 1)),
+    )
+
+
+def get_contracts() -> Tuple[ContractSpec, ...]:
+    """The committed registry: the PS matrix (compress x placement, plus
+    the hierarchical DCN x ICI composition) and the LM schemes."""
+    specs = [
+        _ps_spec(c, p)
+        for c in (None, "int8", "int8_2round")
+        for p in ("replicated", "sharded")
+    ]
+    specs.append(_ps_spec("int8_2round", "replicated", dcn_hosts=2))
+    specs.extend(
+        [_dp_tp_spec(), _pp_spec(), _moe_spec(), _dp_tp_pp_spec()]
+    )
+    return tuple(specs)

@@ -1,0 +1,191 @@
+"""pscheck engine: trace contract specs, run rules, round-trip the
+committed accounting artifact (runs/comm_contract.json).
+
+Tracing is CPU-only and executes nothing: jax.make_jaxpr over abstract
+args gives the collective-level truth, one extra .lower() gives the
+donation attributes. Everything downstream is pure data.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+from .contracts import ContractSpec
+from .walker import Collective, collect_collectives, summarize
+
+CONTRACT_VERSION = 1
+DEFAULT_CONTRACT = "runs/comm_contract.json"
+
+# MLIR attributes marking a donated input: tf.aliasing_output when the
+# lowering already paired it with an output, jax.buffer_donor when the
+# pairing is left to XLA. Either means donation survived lowering.
+_DONOR_MARKS = ("tf.aliasing_output", "jax.buffer_donor")
+
+
+@dataclasses.dataclass(frozen=True)
+class CheckFinding:
+    rule: str
+    config: str
+    message: str
+
+    def to_json(self) -> dict:
+        return {"rule": self.rule, "config": self.config,
+                "message": self.message}
+
+
+@dataclasses.dataclass
+class TraceResult:
+    """One contract spec's measured truth."""
+
+    spec: ContractSpec
+    collectives: List[Collective]
+    summary: List[dict]               # PSC104 accounting rows
+    donor_marks: int                  # donated inputs that survived lowering
+    donated_leaves: int               # leaves of the declared donated args
+    donation_mismatches: List[str]    # in/out aval mismatches (would drop
+                                      # aliasing on the pod)
+
+
+def _tree_leaves_with_none(tree):
+    import jax
+
+    return jax.tree_util.tree_leaves(tree)
+
+
+def _donation_info(built, spec: ContractSpec) -> Tuple[int, int, List[str]]:
+    import jax
+
+    if spec.donation is None:
+        return 0, 0, []
+    lowered = built.step.lower(*built.args)
+    txt = lowered.as_text()
+    marks = sum(txt.count(m) for m in _DONOR_MARKS)
+    out = jax.eval_shape(built.step, *built.args)
+    donated = 0
+    mismatches: List[str] = []
+    for argnum, pos in zip(spec.donation.argnums,
+                           spec.donation.out_positions):
+        in_sub = built.args[argnum]
+        out_sub = out[pos]
+        in_leaves, in_def = jax.tree_util.tree_flatten(in_sub)
+        out_leaves, out_def = jax.tree_util.tree_flatten(out_sub)
+        donated += len(in_leaves)
+        if in_def != out_def:
+            mismatches.append(
+                f"arg {argnum}: donated tree structure != output {pos} "
+                f"structure (aliasing impossible)"
+            )
+            continue
+        for i, (a, b) in enumerate(zip(in_leaves, out_leaves)):
+            if tuple(a.shape) != tuple(b.shape) or str(a.dtype) != str(b.dtype):
+                mismatches.append(
+                    f"arg {argnum} leaf {i}: donated "
+                    f"{a.dtype}{list(a.shape)} but output {pos} returns "
+                    f"{b.dtype}{list(b.shape)} — XLA cannot alias "
+                    f"mismatched buffers, donation is silently dropped"
+                )
+    return marks, donated, mismatches
+
+
+def trace_spec(spec: ContractSpec) -> TraceResult:
+    """Trace one contract's real step and measure its collectives."""
+    import jax
+
+    built = spec.build()
+    closed = jax.make_jaxpr(built.step)(*built.args)
+    out_shapes = jax.eval_shape(built.step, *built.args)
+    flat_out, _ = jax.tree_util.tree_flatten(out_shapes)
+    sel_ids = {
+        id(leaf)
+        for leaf in jax.tree_util.tree_leaves(
+            built.select_params(out_shapes)
+        )
+    }
+    param_idx = [i for i, leaf in enumerate(flat_out) if id(leaf) in sel_ids]
+    colls = collect_collectives(closed, param_out_indices=param_idx)
+    marks, donated, mismatches = _donation_info(built, spec)
+    return TraceResult(
+        spec=spec,
+        collectives=colls,
+        summary=summarize(colls),
+        donor_marks=marks,
+        donated_leaves=donated,
+        donation_mismatches=mismatches,
+    )
+
+
+def trace_registry(
+    specs: Sequence[ContractSpec], only: Optional[Sequence[str]] = None
+) -> List[TraceResult]:
+    chosen = [s for s in specs if only is None or s.name in only]
+    return [trace_spec(s) for s in chosen]
+
+
+# ---------------------------------------------------------------- artifact
+
+def to_contract_json(results: Sequence[TraceResult]) -> dict:
+    from .contracts import MESH_DEVICES
+
+    return {
+        "version": CONTRACT_VERSION,
+        "tool": "pscheck",
+        "mesh_devices": MESH_DEVICES,
+        "configs": {
+            r.spec.name: {
+                "axes": list(r.spec.axes),
+                "collectives": r.summary,
+                "n_collectives": sum(row["count"] for row in r.summary),
+                "total_bytes": sum(row["bytes"] for row in r.summary),
+            }
+            for r in sorted(results, key=lambda r: r.spec.name)
+        },
+    }
+
+
+def load_contract(path: str) -> dict:
+    with open(path, "r", encoding="utf-8") as f:
+        data = json.load(f)
+    if data.get("tool") != "pscheck":
+        raise ValueError(f"{path} is not a pscheck contract artifact")
+    return data
+
+
+def write_contract(path: str, results: Sequence[TraceResult]) -> None:
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(to_contract_json(results), f, indent=2, sort_keys=True)
+        f.write("\n")
+
+
+def run_checks(
+    results: Sequence[TraceResult],
+    contract: Optional[dict],
+    check_stale: bool = True,
+) -> List[CheckFinding]:
+    """Run every rule over traced results; `contract` is the committed
+    artifact (None skips PSC104 — used by --write-contract)."""
+    from .rules import check_result, psc104_roundtrip
+
+    findings: List[CheckFinding] = []
+    for r in results:
+        findings.extend(check_result(r))
+    if contract is not None:
+        findings.extend(psc104_roundtrip(results, contract,
+                                         check_stale=check_stale))
+    findings.sort(key=lambda f: (f.config, f.rule, f.message))
+    return findings
+
+
+def render_text(findings: Sequence[CheckFinding],
+                n_configs: int) -> str:
+    out: List[str] = []
+    for f in findings:
+        out.append(f"{f.config}: {f.rule} {f.message}")
+    rules = sorted({f.rule for f in findings})
+    out.append(
+        f"pscheck: {len(findings)} finding(s)"
+        + (f" ({', '.join(rules)})" if rules else "")
+        + f" across {n_configs} traced config(s)"
+    )
+    return "\n".join(out)

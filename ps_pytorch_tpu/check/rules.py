@@ -1,0 +1,190 @@
+"""pscheck rules PSC101-PSC105: contract checks over a traced step.
+
+| rule   | guards against                                                  |
+|--------|-----------------------------------------------------------------|
+| PSC101 | a declared mesh axis no collective consumes (dead parallelism — |
+|        | e.g. a dropped dp reduction), or a collective riding an axis    |
+|        | the scheme never declared                                       |
+| PSC102 | a gradient reduction that no longer feeds the optimizer: for    |
+|        | each axis with replicated gradient leaves, a reduce of the      |
+|        | declared kind must be an ancestor of the updated params (the    |
+|        | ARCHITECTURE §2 recipe, checked by jaxpr dataflow — a metrics   |
+|        | pmean over the same axis does NOT count)                        |
+| PSC103 | wire-dtype regressions on compressed paths: with an int8 wire   |
+|        | declared, every collective on those axes must carry int8 except |
+|        | the explicitly-allowed scale rows / metrics / update gathers    |
+| PSC104 | silent wire-byte drift: the full per-collective accounting      |
+|        | (kind, axes, dtype, count, bytes) must round-trip against the   |
+|        | committed runs/comm_contract.json                               |
+| PSC105 | dropped donation: every donated input must survive lowering as  |
+|        | a donor/alias mark, and its output partner must match in        |
+|        | structure/shape/dtype (mismatch = XLA silently un-donates)      |
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Sequence
+
+from .core import CheckFinding, TraceResult
+
+RULE_IDS = ("PSC101", "PSC102", "PSC103", "PSC104", "PSC105")
+
+
+def psc101_axes(r: TraceResult) -> List[CheckFinding]:
+    declared = set(r.spec.axes)
+    used = set()
+    for c in r.collectives:
+        used.update(c.axes)
+    out = []
+    for ax in sorted(declared - used):
+        out.append(CheckFinding(
+            "PSC101", r.spec.name,
+            f"declared mesh axis '{ax}' is consumed by no collective "
+            f"(dead parallel axis — dropped reduction?)",
+        ))
+    for ax in sorted(used - declared):
+        out.append(CheckFinding(
+            "PSC101", r.spec.name,
+            f"collective rides undeclared axis '{ax}' "
+            f"(declared: {sorted(declared)})",
+        ))
+    return out
+
+
+def psc102_grad_reduce(r: TraceResult) -> List[CheckFinding]:
+    out = []
+    for req in r.spec.grad_reduce:
+        hit = any(
+            c.feeds_params and req.axis in c.axes and c.kind in req.kinds
+            for c in r.collectives
+        )
+        if not hit:
+            near_misses = sorted({
+                c.kind for c in r.collectives
+                if req.axis in c.axes and c.kind in req.kinds
+            })
+            hint = (
+                " (a matching reduce exists but feeds only non-param "
+                "outputs, e.g. metrics)" if near_misses else ""
+            )
+            out.append(CheckFinding(
+                "PSC102", r.spec.name,
+                f"no {'/'.join(req.kinds)} over axis '{req.axis}' feeds "
+                f"the updated params — replicated gradient leaves are "
+                f"not reduced before the optimizer{hint}",
+            ))
+    return out
+
+
+def psc103_wire(r: TraceResult) -> List[CheckFinding]:
+    wire = r.spec.wire
+    if wire is None:
+        return []
+    out = []
+    for c in r.collectives:
+        if not set(c.axes) & set(wire.axes):
+            continue
+        if c.dtype == wire.payload_dtype:
+            continue
+        allowed = False
+        for a in wire.allow:
+            if a.kind != c.kind or a.dtype != c.dtype:
+                continue
+            if a.axes is not None and not set(c.axes) <= set(a.axes):
+                continue
+            if a.max_bytes is not None and c.bytes > a.max_bytes:
+                continue
+            allowed = True
+            break
+        if not allowed:
+            out.append(CheckFinding(
+                "PSC103", r.spec.name,
+                f"{c.kind} over {list(c.axes)} carries {c.dtype} "
+                f"({c.bytes} B) on a declared {wire.payload_dtype} wire "
+                f"— compression regression (no allowance covers it)",
+            ))
+    return out
+
+
+def psc105_donation(r: TraceResult) -> List[CheckFinding]:
+    if r.spec.donation is None:
+        return []
+    out = []
+    if r.donor_marks < r.donated_leaves:
+        out.append(CheckFinding(
+            "PSC105", r.spec.name,
+            f"only {r.donor_marks} of {r.donated_leaves} donated input "
+            f"buffers survive lowering with a donor/alias mark — "
+            f"donation was dropped (donate_argnums missing or overridden)",
+        ))
+    for msg in r.donation_mismatches:
+        out.append(CheckFinding("PSC105", r.spec.name, msg))
+    return out
+
+
+def check_result(r: TraceResult) -> List[CheckFinding]:
+    return (
+        psc101_axes(r)
+        + psc102_grad_reduce(r)
+        + psc103_wire(r)
+        + psc105_donation(r)
+    )
+
+
+def _row_key(row: dict) -> tuple:
+    return (row["kind"], tuple(row["axes"]), row["dtype"])
+
+
+def psc104_roundtrip(
+    results: Sequence[TraceResult],
+    contract: dict,
+    check_stale: bool = True,
+) -> List[CheckFinding]:
+    """Diff the measured accounting against the committed artifact."""
+    out: List[CheckFinding] = []
+    configs: Dict[str, dict] = contract.get("configs", {})
+    for r in results:
+        pinned = configs.get(r.spec.name)
+        if pinned is None:
+            out.append(CheckFinding(
+                "PSC104", r.spec.name,
+                "config missing from the contract artifact — refresh with "
+                "--write-contract",
+            ))
+            continue
+        want = {_row_key(row): row for row in pinned.get("collectives", [])}
+        got = {_row_key(row): row for row in r.summary}
+        for key in sorted(set(want) | set(got)):
+            kind, axes, dtype = key
+            label = f"{kind} over {list(axes)} [{dtype}]"
+            if key not in want:
+                out.append(CheckFinding(
+                    "PSC104", r.spec.name,
+                    f"unpinned collective appeared: {label} "
+                    f"(count={got[key]['count']}, bytes={got[key]['bytes']})",
+                ))
+            elif key not in got:
+                out.append(CheckFinding(
+                    "PSC104", r.spec.name,
+                    f"pinned collective vanished: {label} "
+                    f"(was count={want[key]['count']}, "
+                    f"bytes={want[key]['bytes']})",
+                ))
+            elif (want[key]["count"] != got[key]["count"]
+                  or want[key]["bytes"] != got[key]["bytes"]):
+                out.append(CheckFinding(
+                    "PSC104", r.spec.name,
+                    f"wire accounting drift for {label}: pinned "
+                    f"count={want[key]['count']} bytes={want[key]['bytes']}"
+                    f", measured count={got[key]['count']} "
+                    f"bytes={got[key]['bytes']}",
+                ))
+    if check_stale:
+        traced = {r.spec.name for r in results}
+        for name in sorted(set(configs) - traced):
+            out.append(CheckFinding(
+                "PSC104", name,
+                "stale contract entry: config no longer in the registry — "
+                "refresh with --write-contract",
+            ))
+    return out

@@ -18,6 +18,7 @@ from .core import (
     lint_paths,
     load_baseline,
     render_text,
+    scanned_files,
     to_baseline_json,
 )
 from .rules import RULE_IDS
@@ -109,7 +110,11 @@ def main(argv=None) -> int:
         # out-of-scope baseline entries are neither matchable nor stale
         # under a rule filter — keep them out of the comparison entirely
         baseline = [b for b in baseline if b.rule in selected]
-    new, matched, stale = apply_baseline(findings, baseline)
+    # staleness is scoped to the files this run actually scanned: an
+    # entry for an unscanned path is not "stale", it is out of scope
+    new, matched, stale = apply_baseline(
+        findings, baseline, scanned_paths=scanned_files(args.paths)
+    )
 
     if args.format == "json":
         axes, axes_src = discover_axes(args.paths)

@@ -120,12 +120,22 @@ _COMPOUND_STMTS = (
 
 def _simple_stmt_spans(tree: ast.AST) -> List[Tuple[int, int]]:
     """(start, end) line spans of every non-compound statement, sorted —
-    the ranges a line-level pragma extends over."""
+    the ranges a line-level pragma extends over.
+
+    Decorator expressions get their own spans: they hang off a compound
+    statement (the decorated def/class), so without this a pragma on the
+    closing line of a formatter-wrapped ``@partial(jax.jit, ...)`` would
+    not reach a finding anchored to the decorator's first line."""
     spans = [
         (n.lineno, n.end_lineno or n.lineno)
         for n in ast.walk(tree)
         if isinstance(n, ast.stmt) and not isinstance(n, _COMPOUND_STMTS)
     ]
+    for n in ast.walk(tree):
+        if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef,
+                          ast.ClassDef)):
+            for dec in n.decorator_list:
+                spans.append((dec.lineno, dec.end_lineno or dec.lineno))
     spans.sort()
     return spans
 
@@ -228,6 +238,13 @@ def lint_source(
     return findings
 
 
+def scanned_files(paths: Sequence[str]) -> List[str]:
+    """The deduped file list a lint run over `paths` covers — the single
+    definition of 'scanned', shared by lint_paths and the CLI's
+    stale-baseline scoping."""
+    return list(dict.fromkeys(iter_py_files(paths)))
+
+
 def lint_paths(paths: Sequence[str]) -> List[Finding]:
     """Lint every .py file under `paths` (two passes: donor factories for
     PSL005 are collected across the whole file set first, so a test file
@@ -235,7 +252,7 @@ def lint_paths(paths: Sequence[str]) -> List[Finding]:
     from .rules import collect_donor_factories
 
     axes, _ = discover_axes(paths)
-    files = list(dict.fromkeys(iter_py_files(paths)))
+    files = scanned_files(paths)
     sources: Dict[str, str] = {}
     trees: Dict[str, ast.AST] = {}
     donors: Dict[str, Tuple[int, ...]] = {}
@@ -298,10 +315,19 @@ def baseline_counts(findings: Sequence[Finding]) -> Counter:
 
 
 def apply_baseline(
-    findings: Sequence[Finding], baseline: Sequence[Finding]
+    findings: Sequence[Finding],
+    baseline: Sequence[Finding],
+    scanned_paths: Optional[Sequence[str]] = None,
 ) -> Tuple[List[Finding], List[Finding], List[Finding]]:
     """Split current findings into (new, baselined); also return stale
-    baseline entries that no longer match anything (safe to prune)."""
+    baseline entries that no longer match anything (safe to prune).
+
+    `scanned_paths` (the files this run actually linted) scopes the
+    staleness report: an entry for a file OUTSIDE the scanned set is
+    neither matchable nor stale — linting `tools/` must not report the
+    package's own baseline entries as "stale" just because their files
+    were not in this run's scope. None (unit tests / full-knowledge
+    callers) keeps every entry eligible."""
     budget = baseline_counts(baseline)
     new: List[Finding] = []
     matched: List[Finding] = []
@@ -311,9 +337,14 @@ def apply_baseline(
             matched.append(f)
         else:
             new.append(f)
+    scanned: Optional[Set[str]] = None
+    if scanned_paths is not None:
+        scanned = {os.path.normpath(p) for p in scanned_paths}
     stale: List[Finding] = []
     leftovers = Counter({k: v for k, v in budget.items() if v > 0})
     for b in baseline:
+        if scanned is not None and os.path.normpath(b.path) not in scanned:
+            continue
         if leftovers.get(b.key, 0) > 0:
             leftovers[b.key] -= 1
             stale.append(b)

@@ -1,0 +1,235 @@
+"""pscheck negative fixtures: deliberately-broken step functions, each
+tripping exactly ONE of PSC101-PSC105 (tests/test_check.py pins that).
+
+These are miniature shard_map "train steps" — (params, x) -> (new_params,
+metrics) — over the same 8-device virtual CPU mesh as the real registry,
+shaped so every rule's failure mode exists somewhere runnable:
+
+- dead_axis:      a declared mesh axis no collective ever consumes
+- metrics_only:   the gradient psum dropped; only the metrics pmean
+                  still rides the axis (the PSC102 near-miss)
+- fat_f32_wire:   an int8 wire whose partial sums return via a fat f32
+                  all_gather (the compression regression PSC103 exists
+                  for)
+- drift:          a perfectly fine step — test_check tampers its pinned
+                  bytes to show PSC104 diffing loudly
+- undonated:      the factory forgets donate_argnums
+- donate_mismatch: donates, but returns params in another dtype, so XLA
+                  can never alias the buffers (silent un-donation)
+- ok_psum:        fully clean (the negative control)
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+import ps_pytorch_tpu  # noqa: F401  (installs the jax.shard_map alias)
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ps_pytorch_tpu.check import (
+    Built,
+    ContractSpec,
+    DonationSpec,
+    GradReduce,
+    WireAllowance,
+    WirePolicy,
+)
+from ps_pytorch_tpu.parallel.mesh import DCN_AXIS, WORKER_AXIS
+
+AXIS = WORKER_AXIS
+N = 8
+
+
+def _mesh_1d() -> Mesh:
+    return Mesh(np.array(jax.devices()[:N]), (AXIS,))
+
+
+def _mesh_2d() -> Mesh:
+    # a hybrid-shaped (hosts x chips) mesh, named with the real axis
+    # constants so pslint's PSL001 stays happy
+    return Mesh(
+        np.array(jax.devices()[:N]).reshape(2, 4), (DCN_AXIS, WORKER_AXIS)
+    )
+
+
+def _args(param_len: int, x_cols: int = 4):
+    params = jax.ShapeDtypeStruct((param_len,), jnp.float32)
+    x = jax.ShapeDtypeStruct((N, x_cols), jnp.float32)
+    return params, x
+
+
+def _built(step, param_len: int, x_cols: int = 4) -> Built:
+    params, x = _args(param_len, x_cols)
+    return Built(step=step, args=(params, x),
+                 select_params=lambda out: out[0])
+
+
+def _dead_axis() -> ContractSpec:
+    def build() -> Built:
+        mesh = _mesh_2d()
+
+        def f(p, x):
+            loss = jnp.sum(p[:4] * x[0])
+            g = jax.grad(lambda q: jnp.sum(q[:4] * x[0]))(p)
+            # BUG: reduced over the chip axis only — the dcn (host) axis
+            # is declared but never consumed by any collective
+            g = lax.psum(g, WORKER_AXIS)
+            return p - 0.1 * g, lax.pmean(loss, WORKER_AXIS)
+
+        step = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P(DCN_AXIS, WORKER_AXIS)),
+            out_specs=(P(), P()), check_vma=False,
+        ))
+        return _built(step, 8)
+
+    return ContractSpec(
+        name="dead_axis", build=build, axes=(DCN_AXIS, WORKER_AXIS),
+        grad_reduce=(GradReduce(WORKER_AXIS, ("psum",)),),
+    )
+
+
+def _metrics_only() -> ContractSpec:
+    def build() -> Built:
+        mesh = _mesh_1d()
+
+        def f(p, x):
+            loss = jnp.sum(p[:4] * x[0])
+            g = jax.grad(lambda q: jnp.sum(q[:4] * x[0]))(p)
+            # BUG: forgot lax.psum(g, AXIS) — each worker applies its own
+            # partial gradient; only the metrics pmean touches the axis
+            return p - 0.1 * g, lax.pmean(loss, AXIS)
+
+        step = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P(AXIS)),
+            out_specs=(P(), P()), check_vma=False,
+        ))
+        return _built(step, 8)
+
+    return ContractSpec(
+        name="metrics_only", build=build, axes=(AXIS,),
+        grad_reduce=(GradReduce(AXIS, ("psum",)),),
+    )
+
+
+def _fat_f32_wire() -> ContractSpec:
+    L = 4096  # per-worker region 512 floats -> 2 KiB f32 all_gather
+
+    def build() -> Built:
+        mesh = _mesh_1d()
+
+        def f(p, x):
+            loss = jnp.sum(p[:4] * x[0])
+            g = jax.grad(lambda q: jnp.sum(q[:4] * x[0]))(p)
+            q = jnp.clip(g * 127.0, -127, 127).astype(jnp.int8)
+            recv = lax.all_to_all(
+                q.reshape(N, L // N), AXIS, split_axis=0, concat_axis=0,
+                tiled=True,
+            )
+            partial = jnp.sum(recv.astype(jnp.int32), axis=0)
+            # BUG: the partial sums return as FULL f32 instead of being
+            # requantized to int8 — the wire is no longer int8
+            full = lax.all_gather(
+                partial.astype(jnp.float32) / 127.0, AXIS, tiled=True
+            )
+            return p - 0.1 * full, lax.pmean(loss, AXIS)
+
+        step = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P(AXIS)),
+            out_specs=(P(), P()), check_vma=False,
+        ))
+        return _built(step, L)
+
+    return ContractSpec(
+        name="fat_f32_wire", build=build, axes=(AXIS,),
+        grad_reduce=(GradReduce(AXIS, ("all_to_all",)),),
+        wire=WirePolicy(
+            axes=(AXIS,), payload_dtype="int8",
+            allow=(
+                WireAllowance(kind="psum", dtype="float32", max_bytes=64,
+                              reason="metrics pmean"),
+                WireAllowance(kind="all_gather", dtype="float32",
+                              max_bytes=1024, reason="scale rows only"),
+            ),
+        ),
+    )
+
+
+def _clean_step(donate: bool, cast=None):
+    mesh = _mesh_1d()
+
+    def f(p, x):
+        loss = jnp.sum(p[:4] * x[0])
+        g = jax.grad(lambda q: jnp.sum(q[:4] * x[0]))(p)
+        g = lax.psum(g, AXIS)
+        new_p = p - 0.1 * g
+        if cast is not None:
+            new_p = new_p.astype(cast)
+        return new_p, lax.pmean(loss, AXIS)
+
+    mapped = jax.shard_map(
+        f, mesh=mesh, in_specs=(P(), P(AXIS)),
+        out_specs=(P(), P()), check_vma=False,
+    )
+    return jax.jit(mapped, donate_argnums=(0,) if donate else ())
+
+
+def _drift() -> ContractSpec:
+    return ContractSpec(
+        name="drift",
+        build=lambda: _built(_clean_step(donate=True), 8),
+        axes=(AXIS,),
+        grad_reduce=(GradReduce(AXIS, ("psum",)),),
+        donation=DonationSpec(argnums=(0,), out_positions=(0,)),
+    )
+
+
+def _undonated() -> ContractSpec:
+    return ContractSpec(
+        name="undonated",
+        # BUG: factory builds the step without donate_argnums while the
+        # contract declares the donation
+        build=lambda: _built(_clean_step(donate=False), 8),
+        axes=(AXIS,),
+        grad_reduce=(GradReduce(AXIS, ("psum",)),),
+        donation=DonationSpec(argnums=(0,), out_positions=(0,)),
+    )
+
+
+def _donate_mismatch() -> ContractSpec:
+    return ContractSpec(
+        name="donate_mismatch",
+        # BUG: donates f32 params but returns them as bf16 — XLA cannot
+        # alias buffers of different byte widths, so donation silently
+        # degrades to a copy on the pod
+        build=lambda: _built(
+            _clean_step(donate=True, cast=jnp.bfloat16), 8
+        ),
+        axes=(AXIS,),
+        grad_reduce=(GradReduce(AXIS, ("psum",)),),
+        donation=DonationSpec(argnums=(0,), out_positions=(0,)),
+    )
+
+
+def _ok_psum() -> ContractSpec:
+    return ContractSpec(
+        name="ok_psum",
+        build=lambda: _built(_clean_step(donate=True), 8),
+        axes=(AXIS,),
+        grad_reduce=(GradReduce(AXIS, ("psum",)),),
+        donation=DonationSpec(argnums=(0,), out_positions=(0,)),
+    )
+
+
+def get_contracts():
+    return (
+        _dead_axis(),
+        _metrics_only(),
+        _fat_f32_wire(),
+        _drift(),
+        _undonated(),
+        _donate_mismatch(),
+        _ok_psum(),
+    )

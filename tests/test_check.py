@@ -1,0 +1,355 @@
+"""pscheck (ps_pytorch_tpu/check): walker dataflow units, one broken-step
+fixture per rule (tests/check_fixtures.py), CLI exit codes, and the
+tier-1 repo gate: every registry contract must hold and the wire-byte
+accounting must round-trip against the committed runs/comm_contract.json
+— so a collective/dtype/byte regression in any scheme fails CI here.
+
+Tracing is CPU-only and executes nothing; the whole file stays well
+under the 60s gate budget (registry traced once, session-scoped).
+"""
+
+import contextlib
+import io
+import json
+import subprocess
+import sys
+from pathlib import Path
+
+import pytest
+
+import ps_pytorch_tpu  # noqa: F401  (installs the jax.shard_map alias)
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ps_pytorch_tpu.check import (
+    collect_collectives,
+    get_contracts,
+    load_contract,
+    run_checks,
+    to_contract_json,
+    trace_registry,
+)
+from ps_pytorch_tpu.check.__main__ import main as check_main
+from ps_pytorch_tpu.parallel.mesh import WORKER_AXIS
+
+REPO = Path(__file__).resolve().parent.parent
+CONTRACT = REPO / "runs" / "comm_contract.json"
+FIXTURES = "tests.check_fixtures"
+
+
+def _run_main(args):
+    buf = io.StringIO()
+    with contextlib.redirect_stdout(buf):
+        rc = check_main(args)
+    return rc, buf.getvalue()
+
+
+# ------------------------------------------------------------------- walker
+
+def test_walker_finds_collectives_with_axes_dtype_bytes():
+    mesh = Mesh(np.array(jax.devices()[:8]), (WORKER_AXIS,))
+
+    def f(x):
+        s = lax.psum(x, WORKER_AXIS)
+        g = lax.all_gather(x.astype(jnp.int8), WORKER_AXIS, tiled=True)
+        return s, g
+
+    mapped = jax.shard_map(
+        f, mesh=mesh, in_specs=P(WORKER_AXIS), out_specs=(P(), P()),
+        check_vma=False,
+    )
+    closed = jax.make_jaxpr(jax.jit(mapped))(
+        jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    )
+    colls = collect_collectives(closed)
+    kinds = {(c.kind, c.dtype): c for c in colls}
+    assert ("psum", "float32") in kinds
+    assert ("all_gather", "int8") in kinds
+    psum = kinds[("psum", "float32")]
+    assert psum.axes == (WORKER_AXIS,)
+    assert psum.bytes == 4 * 4  # per-device [1, 4] f32 shard
+    assert kinds[("all_gather", "int8")].bytes == 4
+
+
+def test_walker_splits_mixed_dtype_collectives():
+    """jax batches a whole-tree psum into ONE eqn with every leaf as an
+    operand; the walker must split it per dtype so a single f32 leaf on
+    an otherwise-int8 wire still surfaces for PSC103."""
+    mesh = Mesh(np.array(jax.devices()[:8]), (WORKER_AXIS,))
+
+    def f(x):
+        tree = {"a": x.astype(jnp.int8).astype(jnp.int32), "b": x * 2.0}
+        return lax.psum(tree, WORKER_AXIS)
+
+    mapped = jax.shard_map(
+        f, mesh=mesh, in_specs=P(WORKER_AXIS), out_specs=P(),
+        check_vma=False,
+    )
+    closed = jax.make_jaxpr(jax.jit(mapped))(
+        jax.ShapeDtypeStruct((8, 4), jnp.float32)
+    )
+    psums = [c for c in collect_collectives(closed) if c.kind == "psum"]
+    dtypes = sorted(c.dtype for c in psums)
+    assert dtypes == ["float32", "int32"], psums
+    assert all(c.bytes == 16 for c in psums)
+
+
+def test_walker_dataflow_distinguishes_param_and_metric_psums():
+    """The PSC102 discriminator: a psum feeding only the metrics output
+    must not be marked feeds_params, even through pjit nesting."""
+    mesh = Mesh(np.array(jax.devices()[:8]), (WORKER_AXIS,))
+
+    def f(p, x):
+        g = lax.psum(x.sum() * jnp.ones_like(p), WORKER_AXIS)
+        metric = lax.pmean(x.sum(), WORKER_AXIS)
+        return p - g, metric
+
+    mapped = jax.shard_map(
+        f, mesh=mesh, in_specs=(P(), P(WORKER_AXIS)),
+        out_specs=(P(), P()), check_vma=False,
+    )
+    closed = jax.make_jaxpr(jax.jit(mapped))(
+        jax.ShapeDtypeStruct((4,), jnp.float32),
+        jax.ShapeDtypeStruct((8, 4), jnp.float32),
+    )
+    colls = collect_collectives(closed, param_out_indices=[0])
+    grad = [c for c in colls if c.bytes == 16]
+    metric = [c for c in colls if c.bytes == 4]
+    assert grad and metric
+    assert all(c.feeds_params for c in grad)
+    assert not any(c.feeds_params for c in metric)
+
+
+def test_walker_is_conservative_inside_scan():
+    """A collective inside a scan body keeps feeds_params when the scan's
+    carry reaches the params (conservative loop treatment)."""
+    mesh = Mesh(np.array(jax.devices()[:8]), (WORKER_AXIS,))
+
+    def f(p, x):
+        def body(carry, xi):
+            return carry + lax.psum(xi, WORKER_AXIS), None
+
+        total, _ = lax.scan(body, jnp.zeros_like(p), x)
+        return p - total
+
+    mapped = jax.shard_map(
+        f, mesh=mesh, in_specs=(P(), P(None, WORKER_AXIS)),
+        out_specs=P(), check_vma=False,
+    )
+    closed = jax.make_jaxpr(jax.jit(mapped))(
+        jax.ShapeDtypeStruct((1, 4), jnp.float32),
+        jax.ShapeDtypeStruct((2, 8, 4), jnp.float32),
+    )
+    colls = collect_collectives(closed, param_out_indices=[0])
+    assert any(c.kind == "psum" and c.feeds_params for c in colls)
+
+
+# ------------------------------------------------- fixtures: one per rule
+
+@pytest.fixture(scope="module")
+def fixture_contract(tmp_path_factory):
+    """Accounting artifact for the fixture registry, with the `drift`
+    config's pinned bytes tampered so PSC104 has something to catch."""
+    path = tmp_path_factory.mktemp("check") / "contract.json"
+    rc, _ = _run_main(
+        ["--registry", FIXTURES, "--write-contract", "--contract",
+         str(path)]
+    )
+    # the write succeeds even though the broken fixtures trip their rules
+    assert rc == 1
+    data = json.loads(path.read_text())
+    assert set(data["configs"]) == {
+        "dead_axis", "metrics_only", "fat_f32_wire", "drift",
+        "undonated", "donate_mismatch", "ok_psum",
+    }
+    data["configs"]["drift"]["collectives"][0]["bytes"] += 1
+    path.write_text(json.dumps(data))
+    return path
+
+
+@pytest.mark.parametrize(
+    "name,rule",
+    [
+        ("dead_axis", "PSC101"),
+        ("metrics_only", "PSC102"),
+        ("fat_f32_wire", "PSC103"),
+        ("drift", "PSC104"),
+        ("undonated", "PSC105"),
+        ("donate_mismatch", "PSC105"),
+    ],
+)
+def test_fixture_trips_exactly_one_rule(fixture_contract, name, rule):
+    rc, out = _run_main(
+        ["--registry", FIXTURES, "--only", name, "--contract",
+         str(fixture_contract), "--format", "json"]
+    )
+    assert rc == 1
+    rules = sorted({f["rule"] for f in json.loads(out)["findings"]})
+    assert rules == [rule], out
+
+
+def test_clean_fixture_passes(fixture_contract):
+    rc, out = _run_main(
+        ["--registry", FIXTURES, "--only", "ok_psum", "--contract",
+         str(fixture_contract), "--format", "json"]
+    )
+    assert rc == 0, out
+    assert json.loads(out)["findings"] == []
+
+
+def test_psc102_message_names_the_metrics_near_miss(fixture_contract):
+    rc, out = _run_main(
+        ["--registry", FIXTURES, "--only", "metrics_only", "--contract",
+         str(fixture_contract), "--format", "json"]
+    )
+    (finding,) = json.loads(out)["findings"]
+    assert "feeds only non-param outputs" in finding["message"]
+
+
+# --------------------------------------------------------------- CLI usage
+
+def test_cli_usage_errors(tmp_path):
+    rc, _ = _run_main(["--registry", FIXTURES, "--only", "no_such_config"])
+    assert rc == 2
+    rc, _ = _run_main(
+        ["--registry", FIXTURES, "--write-contract", "--only", "ok_psum",
+         "--contract", str(tmp_path / "c.json")]
+    )
+    assert rc == 2
+    assert not (tmp_path / "c.json").exists()
+    rc, _ = _run_main(["--registry", "tests.no_such_registry_xyz"])
+    assert rc == 2
+
+
+def test_cli_list_names_registry_configs():
+    rc, out = _run_main(["--list"])
+    assert rc == 0
+    names = out.split()
+    assert "ps_none_replicated" in names
+    assert "ps_int8_2round_sharded" in names
+    assert "dp_tp_pp" in names
+
+
+def test_check_sh_exits_nonzero_on_fixture_violation(fixture_contract):
+    """The acceptance path: tools/check.sh itself (not just the python
+    entry point) fails loudly on a contract violation."""
+    proc = subprocess.run(
+        ["bash", "tools/check.sh", "--registry", FIXTURES,
+         "--only", "dead_axis", "--contract", str(fixture_contract)],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "PSC101" in proc.stdout
+
+
+def test_check_sh_refuses_write_with_positional_args():
+    proc = subprocess.run(
+        ["bash", "tools/check.sh", "--write-contract", "somepath"],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert proc.returncode == 2
+    assert "full registry" in proc.stderr
+
+
+def test_check_sh_write_with_contract_value_is_not_refused(tmp_path):
+    """`--contract <path>` takes a value: the value must not be mistaken
+    for a positional path and trip the write-refusal — the combination
+    reaches the python CLI and the artifact is written."""
+    out = tmp_path / "cc.json"
+    proc = subprocess.run(
+        ["bash", "tools/check.sh", "--registry", FIXTURES,
+         "--write-contract", "--contract", str(out)],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    # rc 1: the broken fixtures trip their rules, but the write happened
+    # (no exit-2 refusal from the shell gate)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "wrote 7 config(s)" in proc.stdout
+    assert out.exists()
+
+
+def test_lint_sh_refuses_write_with_explicit_paths():
+    proc = subprocess.run(
+        ["bash", "tools/lint.sh", "ps_pytorch_tpu", "--write-baseline"],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert proc.returncode == 2
+    assert "gate's" in proc.stderr
+
+
+# ------------------------------------------------------------ tier-1 gate
+
+@pytest.fixture(scope="module")
+def registry_results():
+    return trace_registry(get_contracts())
+
+
+def test_registry_contracts_hold(registry_results):
+    """THE gate (rules PSC101/102/103/105): every scheme's traced step
+    satisfies its declared communication contract."""
+    findings = run_checks(registry_results, contract=None)
+    assert findings == [], "\n".join(
+        f"{f.config}: {f.rule} {f.message}" for f in findings
+    )
+
+
+def test_committed_contract_roundtrips(registry_results):
+    """PSC104: the committed artifact matches the live trace bit-for-bit
+    (both through run_checks and as raw JSON)."""
+    committed = load_contract(str(CONTRACT))
+    findings = run_checks(registry_results, committed)
+    assert findings == [], "\n".join(
+        f"{f.config}: {f.rule} {f.message}" for f in findings
+    )
+    assert to_contract_json(registry_results) == committed
+
+
+def test_committed_contract_pins_an_int8_wire():
+    """The §6b headline in artifact form: the 2-round schemes' on-wire
+    payloads are int8 — both the all_to_all scatter round and the
+    all_gather return round."""
+    committed = load_contract(str(CONTRACT))
+    for name in ("ps_int8_2round_replicated", "ps_int8_2round_sharded",
+                 "ps_hier_int8_2round_replicated"):
+        rows = committed["configs"][name]["collectives"]
+        int8_rows = [r for r in rows if r["dtype"] == "int8"]
+        assert int8_rows, f"{name} pins no int8 wire entry"
+        assert any(r["kind"] == "all_to_all" for r in int8_rows), name
+    repl = committed["configs"]["ps_int8_2round_replicated"]["collectives"]
+    assert any(
+        r["kind"] == "all_gather" and r["dtype"] == "int8" for r in repl
+    )
+
+
+def test_check_sh_gate_passes():
+    """End-to-end: the exact command CI documentation points at."""
+    proc = subprocess.run(
+        ["bash", "tools/check.sh"],
+        capture_output=True, text=True, cwd=str(REPO),
+    )
+    assert proc.returncode == 0, proc.stdout + proc.stderr
+    assert "0 finding(s)" in proc.stdout
+
+
+def test_predicted_scaling_contract_cross_check():
+    """tools/predicted_scaling.py's kind-level cross-check against the
+    pscheck artifact: the committed scaling rows must agree, and a
+    fabricated extra HLO kind must be caught."""
+    sys.path.insert(0, str(REPO / "tools"))
+    try:
+        from predicted_scaling import contract_cross_check
+    finally:
+        sys.path.pop(0)
+    contract = load_contract(str(CONTRACT))
+    scaling = json.loads((REPO / "runs" / "predicted_scaling.json").read_text())
+    report = contract_cross_check(scaling["rows"], contract)
+    assert report["ok"], report
+    assert all(r["ok"] for r in report["results"])
+    # a wire regression shows up as a kind mismatch
+    bad = json.loads(json.dumps(scaling["rows"][:1]))
+    bad[0]["by_kind"]["all-to-all"] = {"count": 1, "bytes": 1}
+    report = contract_cross_check(bad, contract)
+    assert report["ok"] is False

@@ -1,0 +1,76 @@
+# Shared gate-dispatch skeleton for the static-analysis entry points
+# (tools/lint.sh and tools/check.sh). Source this file, then call:
+#
+#   gate_dispatch WRITE_FLAG VALUE_FLAGS REFUSE_MSG \
+#       gate-cmd... -- passthrough-cmd... -- "$@"
+#
+# VALUE_FLAGS is a space-separated list of options that consume the NEXT
+# argument (e.g. "--baseline --select --format"); their values must not
+# be mistaken for positional paths.
+#
+# Dispatch rules (identical for both tools, so the argument-validation
+# logic lives in exactly one place):
+#   no user args                  -> exec the gate command (what CI runs)
+#   WRITE_FLAG + a positional arg -> REFUSE_MSG on stderr, exit 2: a
+#                                    refresh over a subset would silently
+#                                    drop the other entries and break the
+#                                    next gate run
+#   first arg is a --flag         -> exec the gate command + user flags
+#                                    (so --write-* refreshes exactly the
+#                                    scope CI checks)
+#   first arg is positional       -> exec the passthrough command + args
+#                                    (ad-hoc scope; the python CLI still
+#                                    validates them)
+
+gate_dispatch() {
+    local write_flag="$1" value_flags="$2" refuse_msg="$3"
+    shift 3
+    local -a gate_cmd=() pass_cmd=()
+    while [ "$#" -gt 0 ] && [ "$1" != "--" ]; do
+        gate_cmd+=("$1")
+        shift
+    done
+    shift
+    while [ "$#" -gt 0 ] && [ "$1" != "--" ]; do
+        pass_cmd+=("$1")
+        shift
+    done
+    shift
+
+    if [ "$#" -eq 0 ]; then
+        exec "${gate_cmd[@]}"
+    fi
+    local has_paths=0 has_write=0 skip_value=0 arg flag
+    for arg in "$@"; do
+        if [ "$skip_value" = 1 ]; then
+            skip_value=0
+            continue
+        fi
+        case "$arg" in
+            "$write_flag")
+                has_write=1
+                ;;
+            --*)
+                # a value-taking option consumes the next argument
+                # (unless given as --flag=value)
+                for flag in $value_flags; do
+                    if [ "$arg" = "$flag" ]; then
+                        skip_value=1
+                        break
+                    fi
+                done
+                ;;
+            *)
+                has_paths=1
+                ;;
+        esac
+    done
+    if [ "$has_write" = 1 ] && [ "$has_paths" = 1 ]; then
+        printf '%s\n' "$refuse_msg" >&2
+        exit 2
+    fi
+    case "$1" in
+        --*) exec "${gate_cmd[@]}" "$@" ;;
+        *) exec "${pass_cmd[@]}" "$@" ;;
+    esac
+}

@@ -1,0 +1,29 @@
+#!/usr/bin/env bash
+# pscheck entry point: jaxpr-level contract checking of the parallel
+# schemes (rules PSC101-PSC105) against runs/comm_contract.json.
+#
+#   tools/check.sh                   # gate: trace the registry, verify all
+#                                    # contracts + the committed accounting
+#   tools/check.sh --only ps_none_replicated   # subset (PSC104 stale
+#                                              # checking is skipped)
+#   tools/check.sh --write-contract  # refresh runs/comm_contract.json
+#                                    # after a deliberate wire change
+#
+# Exit 0 = every contract holds, 1 = findings, 2 = usage error. The same
+# check runs in tier-1 via tests/test_check.py, so a wire regression in
+# any scheme fails CI. The CLI re-execs itself into the scrubbed 8-device
+# CPU environment if needed (tpu_env.clean_cpu_env).
+set -euo pipefail
+cd "$(dirname "$0")/.."
+source tools/_gate_common.sh
+
+REFUSE="tools/check.sh: pscheck takes no positional paths; a
+--write-contract refresh always covers the full registry. Drop the
+positional arguments, or call python -m ps_pytorch_tpu.check directly
+with an explicit --registry/--contract."
+
+gate_dispatch --write-contract "--contract --registry --only --format" \
+    "$REFUSE" \
+    python -m ps_pytorch_tpu.check -- \
+    python -m ps_pytorch_tpu.check -- \
+    "$@"

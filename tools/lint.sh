@@ -1,48 +1,32 @@
 #!/usr/bin/env bash
 # pslint entry point: JAX/TPU-aware static analysis over the package.
 #
-#   tools/lint.sh                 # gate: package + tests/ vs committed baseline
-#   tools/lint.sh tools/ bench.py # lint other trees (ad hoc; the committed
+#   tools/lint.sh                 # gate: package + tests/ + tools/ +
+#                                 # analysis/ + bench.py vs committed baseline
+#   tools/lint.sh cli/foo.py      # lint other trees (ad hoc; the committed
 #                                 # baseline still applies if entries match)
 #   tools/lint.sh --write-baseline  # refresh lint_baseline.json over the
-#                                   # gate's paths (package + tests/)
+#                                   # gate's paths
 #
 # Exit 0 = clean (or fully baselined), 1 = new findings, 2 = usage error.
 # The same check runs in tier-1 via tests/test_lint.py::test_package_is_
 # clean_against_committed_baseline, so CI fails on any new finding.
 set -euo pipefail
 cd "$(dirname "$0")/.."
+source tools/_gate_common.sh
 
 # tests/ is in the gate on purpose: donated-buffer reuse (PSL005) and
 # axis literals live there, and CPU-only CI cannot catch donation bugs
-# at runtime (donation is a warning on CPU, a crash on TPU)
-GATE_PATHS=(ps_pytorch_tpu tests)
+# at runtime (donation is a warning on CPU, a crash on TPU). tools/,
+# analysis/, and bench.py are gated because their host loops drive the
+# TPU (PSL002 recompilation and PSL004 sync hazards live there too).
+GATE_PATHS=(ps_pytorch_tpu tests tools analysis bench.py)
 
-if [ "$#" -eq 0 ]; then
-    exec python -m ps_pytorch_tpu.lint "${GATE_PATHS[@]}" --baseline lint_baseline.json
-fi
+REFUSE="tools/lint.sh: --write-baseline always refreshes over the gate's
+paths (${GATE_PATHS[*]}); drop the explicit paths, or call
+python -m ps_pytorch_tpu.lint directly with an explicit --baseline"
 
-has_paths=0 has_write=0
-for arg in "$@"; do
-    case "$arg" in
-        --write-baseline) has_write=1 ;;
-        --*) ;;
-        *) has_paths=1 ;;
-    esac
-done
-if [ "$has_write" = 1 ] && [ "$has_paths" = 1 ]; then
-    # writing from a subset of the gate's paths would silently drop the
-    # other paths' baseline entries and break the next gate run
-    echo "tools/lint.sh: --write-baseline always refreshes over the gate's" >&2
-    echo "paths (${GATE_PATHS[*]}); drop the explicit paths, or call" >&2
-    echo "python -m ps_pytorch_tpu.lint directly with an explicit --baseline" >&2
-    exit 2
-fi
-case "$1" in
-    --*)
-        # flag-only invocation (e.g. --write-baseline): keep the gate's
-        # paths so the refreshed baseline covers exactly what CI lints
-        exec python -m ps_pytorch_tpu.lint "${GATE_PATHS[@]}" --baseline lint_baseline.json "$@" ;;
-    *)
-        exec python -m ps_pytorch_tpu.lint "$@" ;;
-esac
+gate_dispatch --write-baseline "--baseline --select --format" "$REFUSE" \
+    python -m ps_pytorch_tpu.lint "${GATE_PATHS[@]}" --baseline lint_baseline.json -- \
+    python -m ps_pytorch_tpu.lint -- \
+    "$@"

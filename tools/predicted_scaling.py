@@ -74,6 +74,63 @@ MODES = {
     "hier_2round": dict(compress="int8_2round", hier=True),
 }
 
+# pscheck cross-check: each mode's HLO collectives must agree in KIND
+# with the jaxpr-level accounting pscheck pins for the matching contract
+# config (runs/comm_contract.json, rule PSC104's artifact). Bytes are
+# not compared — the contract traces LeNet on the 8-chip test mesh, this
+# tool partitions ResNet at each worker count — but a kind appearing on
+# one side only means the two measurements no longer describe the same
+# wire protocol, which is exactly the drift PSC104 exists to catch.
+MODE_CONTRACT_CONFIG = {
+    "none": "ps_none_replicated",
+    "int8": "ps_int8_replicated",
+    "int8_2round": "ps_int8_2round_replicated",
+    "hier_2round": "ps_hier_int8_2round_replicated",
+}
+
+# jaxpr collective kind (pscheck walker) -> compiled HLO op kind
+_JAXPR_TO_HLO_KIND = {
+    "psum": "all-reduce",
+    "pmax": "all-reduce",
+    "pmin": "all-reduce",
+    "all_gather": "all-gather",
+    "psum_scatter": "reduce-scatter",
+    "all_to_all": "all-to-all",
+    "ppermute": "collective-permute",
+}
+
+
+def contract_cross_check(rows: list, contract: dict) -> dict:
+    """Compare each measured row's HLO collective-kind set against the
+    pscheck contract entry for its mode. Returns a report block with one
+    result per row; ok=None marks rows with no contract entry."""
+    results = []
+    for row in rows:
+        cfg_name = MODE_CONTRACT_CONFIG.get(row["mode"])
+        cfg = contract.get("configs", {}).get(cfg_name) if cfg_name else None
+        if cfg is None:
+            results.append({
+                "workers": row["workers"], "mode": row["mode"],
+                "config": cfg_name, "ok": None,
+                "error": "no pscheck contract entry for this mode",
+            })
+            continue
+        expected = sorted({
+            _JAXPR_TO_HLO_KIND.get(c["kind"], c["kind"])
+            for c in cfg.get("collectives", [])
+        })
+        measured = sorted(row.get("by_kind", {}))
+        results.append({
+            "workers": row["workers"], "mode": row["mode"],
+            "config": cfg_name, "expected_kinds": expected,
+            "measured_kinds": measured, "ok": expected == measured,
+        })
+    return {
+        "ok": all(r["ok"] is not False for r in results),
+        "results": results,
+    }
+
+
 # ring/torus step-count factors per collective kind (alpha-beta model,
 # bytes multiplier applied to the payload): all-reduce moves every byte
 # twice minus the 1/n it keeps; one-shot redistributions move (n-1)/n.
@@ -228,6 +285,10 @@ def main(argv=None) -> dict:
                    help="single-chip step seconds; default: banked TPU record")
     p.add_argument("--timeout", type=int, default=900)
     p.add_argument("--out", default=None)
+    p.add_argument("--contract", default=None,
+                   help="pscheck contract artifact to cross-check "
+                        "collective kinds against (default: "
+                        "runs/comm_contract.json if present)")
     p.add_argument("--one-workers", type=int, default=None,
                    help=argparse.SUPPRESS)  # child mode
     p.add_argument("--one-mode", default=None, help=argparse.SUPPRESS)
@@ -276,7 +337,30 @@ def main(argv=None) -> dict:
                   f"{rows[-1]['speedup_no_overlap']}x-"
                   f"{rows[-1]['speedup_full_overlap']}x", file=sys.stderr)
 
+    contract_path = args.contract or os.path.join(
+        REPO, "runs", "comm_contract.json"
+    )
+    contract_block = None
+    if os.path.exists(contract_path):
+        with open(contract_path) as f:
+            contract_block = contract_cross_check(rows, json.load(f))
+        contract_block["path"] = os.path.relpath(contract_path, REPO)
+        if not contract_block["ok"]:
+            bad = [r for r in contract_block["results"]
+                   if r["ok"] is False]
+            for r in bad:
+                print(
+                    f"# CONTRACT MISMATCH {r['workers']} workers / "
+                    f"{r['mode']}: HLO kinds {r['measured_kinds']} != "
+                    f"pscheck contract kinds {r['expected_kinds']} "
+                    f"({r['config']})", file=sys.stderr,
+                )
+    elif args.contract:
+        print(f"# contract {args.contract} not found; cross-check skipped",
+              file=sys.stderr)
+
     report = {
+        "contract_check": contract_block,
         "model": {
             "t1_seconds": t1, "t1_source": t1_src,
             "ici_gbs_one_way": args.ici_gbs,
@@ -329,4 +413,8 @@ def main(argv=None) -> dict:
 
 
 if __name__ == "__main__":
-    main()
+    _report = main()
+    _cc = _report.get("contract_check")
+    # a kind-level mismatch against the pscheck artifact is a wire
+    # regression — fail the process so scripted runs can't commit it
+    sys.exit(1 if (_cc and not _cc["ok"]) else 0)

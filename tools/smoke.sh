@@ -1,9 +1,11 @@
 #!/usr/bin/env bash
 # One-shot smoke of the full product surface on a virtual 8-device CPU mesh
-# (no TPU needed). Exercises: the multi-chip dryrun (all parallelism axes),
-# the PS CNN trainer + evaluator, the LM trainer on tp with vocab-parallel
-# embedding + the LM evaluator with KV-cache sampling, and the headline
-# benchmark in its trimmed form. Budget ~5 minutes of CPU (compiles dominate).
+# (no TPU needed). Exercises: both static-analysis gates (pslint source
+# gate, pscheck jaxpr contract gate), the multi-chip dryrun (all
+# parallelism axes), the PS CNN trainer + evaluator, the LM trainer on tp
+# with vocab-parallel embedding + the LM evaluator with KV-cache sampling,
+# and the headline benchmark in its trimmed form. Budget ~5 minutes of CPU
+# (compiles dominate).
 #
 #   bash tools/smoke.sh
 set -euo pipefail
@@ -20,6 +22,12 @@ run() {
 
 TMP=$(mktemp -d)
 trap 'rm -rf "$TMP"' EXIT
+
+# static analysis first: cheapest signal, fails fastest. lint.sh reads
+# only source text; check.sh traces the real step functions on the same
+# scrubbed 8-device CPU environment the rest of the smoke uses.
+run bash tools/lint.sh
+run bash tools/check.sh
 
 run python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
 

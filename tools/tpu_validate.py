@@ -33,6 +33,7 @@ Smoke (CPU, interpret): PS_TPU_PALLAS_INTERPRET=1 JAX_PLATFORMS=cpu \
 from __future__ import annotations
 
 import argparse
+import functools
 import json
 import os
 import sys
@@ -80,16 +81,72 @@ def _normed(x):
     return (x / (jnp.sqrt(jnp.mean(jnp.square(x.astype(jnp.float32)))) + 1e-6)).astype(x.dtype)
 
 
+@functools.lru_cache(maxsize=None)
+def _flash_fns():
+    """Jitted flash/naive/oracle/grad callables, built ONCE per process.
+    jax.jit recompiles per input shape on its own, so the loop over
+    sequence lengths must reuse these callables — rebuilding them per
+    iteration (the old shape of this code) made every cache lookup miss
+    (pslint PSL002)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ps_pytorch_tpu.ops.flash_attention import flash_attention
+    from ps_pytorch_tpu.parallel.ring_attention import full_attention
+
+    def _flash(q, k, v):
+        return flash_attention(q, k, v, causal=True)
+
+    def _naive(q, k, v):
+        return full_attention(q, k, v, causal=True)
+
+    # the precision config is read at TRACE time, so it must be entered
+    # inside the traced body — a `with` around jax.jit() construction
+    # (or around anything but the first call) is a silent no-op
+    def _hi(fn):
+        def wrapped(q, k, v):
+            with jax.default_matmul_precision("highest"):
+                return fn(q, k, v, causal=True)
+        return jax.jit(wrapped)
+
+    # gradient functions (flash: custom VJP; naive: autodiff of the
+    # highest-precision oracle)
+    def loss_flash(q, k, v):
+        o = flash_attention(q, k, v, causal=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def loss_naive(q, k, v):
+        o = full_attention(q, k, v, causal=True)
+        return jnp.sum(o.astype(jnp.float32) ** 2)
+
+    def loss_naive_hi(q, k, v):
+        with jax.default_matmul_precision("highest"):
+            return loss_naive(q, k, v)
+
+    return {
+        "flash": jax.jit(_flash),
+        "naive": jax.jit(_naive),
+        "oracle": _hi(full_attention),
+        "flash_hi": _hi(flash_attention),
+        "gf": jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2))),
+        "gn": jax.jit(jax.grad(loss_naive_hi, argnums=(0, 1, 2))),
+        # timing comparator: DEFAULT-precision naive grad — gn's "highest"
+        # matmuls run multi-pass on the MXU and would inflate bwd_speedup
+        "gn_time": jax.jit(jax.grad(loss_naive, argnums=(0, 1, 2))),
+    }
+
+
 def bench_flash(seq_lens, dtype_name, quick):
     import jax
     import jax.numpy as jnp
     import numpy as np
 
-    from ps_pytorch_tpu.ops.flash_attention import flash_attention
-    from ps_pytorch_tpu.parallel.ring_attention import full_attention
-
     dtype = jnp.bfloat16 if dtype_name == "bfloat16" else jnp.float32
     on_cpu = jax.default_backend() == "cpu"
+    fns = _flash_fns()
+    flash, naive = fns["flash"], fns["naive"]
+    oracle, flash_hi = fns["oracle"], fns["flash_hi"]
+    gf, gn, gn_time = fns["gf"], fns["gn"], fns["gn_time"]
     rows = []
     for t in seq_lens:
         b, h, d = (1, 4, 64) if t >= 4096 else (2, 8, 64)
@@ -97,43 +154,8 @@ def bench_flash(seq_lens, dtype_name, quick):
         mk = lambda: jnp.asarray(rng.randn(b, t, h, d), dtype) * 0.5
         q, k, v = mk(), mk(), mk()
 
-        flash = jax.jit(lambda q, k, v: flash_attention(q, k, v, causal=True))
-        naive = jax.jit(lambda q, k, v: full_attention(q, k, v, causal=True))
-
-        # the precision config is read at TRACE time, so it must be entered
-        # inside the traced body — a `with` around jax.jit() construction
-        # (or around anything but the first call) is a silent no-op
-        def _hi(fn):
-            def wrapped(q, k, v):
-                with jax.default_matmul_precision("highest"):
-                    return fn(q, k, v, causal=True)
-            return jax.jit(wrapped)
-
-        oracle = _hi(full_attention)
-        flash_hi = _hi(flash_attention)
-
         def _get(x):
             return jax.device_get(x).astype(np.float32)
-
-        # gradient functions (flash: custom VJP; naive: autodiff of the
-        # highest-precision oracle)
-        def loss_flash(q, k, v):
-            o = flash_attention(q, k, v, causal=True)
-            return jnp.sum(o.astype(jnp.float32) ** 2)
-
-        def loss_naive(q, k, v):
-            o = full_attention(q, k, v, causal=True)
-            return jnp.sum(o.astype(jnp.float32) ** 2)
-
-        def loss_naive_hi(q, k, v):
-            with jax.default_matmul_precision("highest"):
-                return loss_naive(q, k, v)
-
-        gf = jax.jit(jax.grad(loss_flash, argnums=(0, 1, 2)))
-        gn = jax.jit(jax.grad(loss_naive_hi, argnums=(0, 1, 2)))
-        # timing comparator: DEFAULT-precision naive grad — gn's "highest"
-        # matmuls run multi-pass on the MXU and would inflate bwd_speedup
-        gn_time = jax.jit(jax.grad(loss_naive, argnums=(0, 1, 2)))
 
         # every naive/oracle evaluation materializes the [B,H,T,T] scores
         # tensor — beyond T=8192 that OOMs (17 GB at the LM bench shape,
@@ -254,24 +276,35 @@ def _gate_checks(row, on_cpu):
     return checks
 
 
-def bench_quantizers(quick):
+@functools.lru_cache(maxsize=None)
+def _quant_fns(block_size):
+    """Jitted (encode, decode) pair per block size — cached so the n x
+    block-size sweep reuses one compiled pair per config instead of
+    rebuilding jit wrappers every iteration (pslint PSL002)."""
     import jax
-    import jax.numpy as jnp
-    import numpy as np
 
     from ps_pytorch_tpu.ops import quantize as qz
+
+    enc = jax.jit(functools.partial(qz.quantize_int8, block_size=block_size))
+
+    def _dec(q, s, shape):
+        return qz.dequantize_int8(q, s, block_size=block_size, shape=shape)
+
+    dec = jax.jit(_dec, static_argnames=("shape",))
+    return enc, dec
+
+
+def bench_quantizers(quick):
+    import jax.numpy as jnp
+    import numpy as np
 
     rows = []
     rng = np.random.RandomState(0)
     for n in ([1 << 20] if quick else [1 << 20, 1 << 24]):
         x = jnp.asarray(rng.randn(n).astype(np.float32))
         for name, bs in [("per_tensor", 0), ("per_block_4096", 4096)]:
-            enc = jax.jit(lambda a, b=bs: qz.quantize_int8(a, block_size=b))
-            dec = jax.jit(
-                lambda q, s, b=bs: qz.dequantize_int8(
-                    q, s, block_size=b, shape=x.shape if b else None
-                )
-            )
+            enc, _dec = _quant_fns(bs)
+            dec = functools.partial(_dec, shape=x.shape if bs else None)
             q, scale = enc(x)
             back = dec(q, scale)
             err = float(jnp.max(jnp.abs(back - x)))
@@ -311,7 +344,6 @@ def bench_ring_flash(quick):
     import numpy as np
 
     from ps_pytorch_tpu.parallel.ring_attention import (
-        full_attention,
         make_ring_attention,
         make_seq_mesh,
     )
@@ -324,12 +356,8 @@ def bench_ring_flash(quick):
     q, k, v = mk(), mk(), mk()
     ring = make_ring_attention(mesh, causal=True, impl="flash")
     got = jax.device_get(ring(q, k, v))
-    with jax.default_matmul_precision("highest"):
-        want = jax.device_get(
-            jax.jit(lambda q, k, v: full_attention(q, k, v, causal=True))(
-                q, k, v
-            )
-        )
+    # _flash_fns' oracle enters "highest" precision inside the traced body
+    want = jax.device_get(_flash_fns()["oracle"](q, k, v))
     err = float(np.max(np.abs(got - want)))
     bound = F32_TIGHT_BOUND if on_cpu else F32_DEFAULT_PRECISION_BOUND
     row = {
