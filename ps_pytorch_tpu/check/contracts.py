@@ -106,6 +106,12 @@ _SCALE_GATHER = WireAllowance(
     kind="all_gather", dtype="float32", max_bytes=4096,
     reason="round-2 quantization scale rows",
 )
+# the non-finite gradient guard's mesh-consensus flag: one int32 pmin,
+# 4 bytes per step (resilience/guard.py; PSConfig.nonfinite_guard)
+_FINITE_PMIN = WireAllowance(
+    kind="pmin", dtype="int32", max_bytes=8,
+    reason="non-finite gradient guard flag (skip-step consensus)",
+)
 
 
 def _lenet_ps_built(cfg) -> Built:
@@ -176,7 +182,7 @@ def _ps_spec(compress, placement, dcn_hosts: int = 1) -> ContractSpec:
 
     wire = None
     if compress == "int8_2round":
-        allow = [_METRICS_PSUM, _SCALE_PMAX, _SCALE_GATHER]
+        allow = [_METRICS_PSUM, _SCALE_PMAX, _SCALE_GATHER, _FINITE_PMIN]
         if placement == "sharded":
             allow.append(
                 WireAllowance(

@@ -19,20 +19,75 @@ optimizer state, BN stats, step) — accelerator-agnostic host arrays —
 optionally wrapped in the native C++ codec (ops/codec.py, the Blosc-role
 equivalent: reference compression.py w_compress wraps checkpointed weights
 too). Compressed files carry a 'PSCK' magic; load auto-detects either form.
+
+Integrity (resilience layer): every file ends with an 8-byte CRC32
+trailer — b'PSC1' + crc32(everything before it) — written inside the same
+atomic write, so on-disk corruption (bit rot, torn NFS replication, a
+fault-injected truncation) is detected at read time instead of surfacing
+as a cryptic msgpack error mid-resume. Trailer-less files written before
+this layer existed still load (the trailer is recognized, never
+required), so existing runs/ artifacts and in-flight --resume dirs stay
+valid. `latest_valid_step` + `quarantine_checkpoint` turn a corrupt
+newest checkpoint into a fall-back instead of a crash, and all file I/O
+retries transient OSErrors with bounded exponential backoff
+(resilience/retry.py — the shared-NFS evaluator is where transient EIO
+lives).
 """
 
 from __future__ import annotations
 
+import logging
 import os
 import re
+import struct
 import time
+import zlib
 from typing import Iterator, Optional
 
 import jax
 from flax import serialization
 
+from .resilience import retry_io
+from .resilience.guard import reconcile_guard_state
+
+logger = logging.getLogger("ps_pytorch_tpu")
+
 CKPT_RE = re.compile(r"^model_step_(\d+)$")
 COMPRESSED_MAGIC = b"PSCK"
+# integrity trailer: magic + little-endian crc32 of all preceding bytes
+TRAILER_MAGIC = b"PSC1"
+TRAILER_LEN = len(TRAILER_MAGIC) + 4
+# suffix a quarantined (corrupt) checkpoint is renamed to; CKPT_RE no
+# longer matches it, so available_steps/resume stop seeing it
+QUARANTINE_SUFFIX = ".corrupt"
+# top-level PSTrainState fields that are observability, not math: when a
+# checkpoint predates the field, loading resets it to the target's fresh
+# value instead of erroring (unlike comm_state, whose silent loss would
+# change the training trajectory — see load_checkpoint). Each maps to the
+# owning module's reconcile hook — (stored_dict, fresh_dict) -> merged —
+# so this layer never learns the field's internals
+RESETTABLE_FIELDS = {"guard_state": reconcile_guard_state}
+
+
+class CheckpointError(Exception):
+    """Base for checkpoint integrity/IO failures."""
+
+
+class CheckpointCorruptError(CheckpointError):
+    """The on-disk bytes are damaged (CRC mismatch, truncation, codec or
+    msgpack failure) — retrying will not help; quarantine + fall back."""
+
+
+class CheckpointWriteError(CheckpointError):
+    """A (possibly background) checkpoint write failed; carries the step
+    and path so the failure is actionable when it surfaces at wait()."""
+
+    def __init__(self, step: int, path: str, cause: BaseException):
+        super().__init__(
+            f"checkpoint write failed for step {step} at {path}: {cause}"
+        )
+        self.step = step
+        self.path = path
 
 
 def checkpoint_path(model_dir: str, step: int) -> str:
@@ -65,31 +120,61 @@ def _gather_host_state(state):
     return jax.tree.map(leaf, state)
 
 
-def save_checkpoint(state, model_dir: str, step: int, compress: bool = False) -> str:
+def save_checkpoint(state, model_dir: str, step: int, compress: bool = False,
+                    faults=None) -> str:
     """Atomically write `state` (any flax-serializable pytree) for `step`.
 
     Multi-host: collective (all processes must call it — the gather is a
     collective op); only process 0 writes the file, preserving the
     single-writer guarantee, and a barrier after the write means the
-    write has COMPLETED before any process returns. The path is on
-    process 0's filesystem: reading it from other processes (e.g.
-    --resume after preemption) requires `model_dir` to be on storage all
-    hosts share — a gcsfuse bucket (tools/tpu_cluster.py mount) or NFS,
-    exactly like the reference's NFS train_dir (README.md:23)."""
+    write has COMPLETED before any process returns. A write FAILURE on
+    process 0 must reach that barrier too — raising before it would
+    strand processes 1..N-1 in the collective forever — so the error is
+    held across an ok/fail broadcast and then raised on every process
+    (a failed checkpoint is a collective outcome, not a process-0
+    secret). The path is on process 0's filesystem: reading it from
+    other processes (e.g. --resume after preemption) requires
+    `model_dir` to be on storage all hosts share — a gcsfuse bucket
+    (tools/tpu_cluster.py mount) or NFS, exactly like the reference's
+    NFS train_dir (README.md:23)."""
     host_state = _gather_host_state(state)
     path = checkpoint_path(model_dir, step)
+    err = None
     if jax.process_index() == 0:
-        _write_host_state(host_state, model_dir, step, compress)
+        try:
+            _write_host_state(host_state, model_dir, step, compress,
+                              faults=faults)
+        except BaseException as e:
+            err = e
     if jax.process_count() > 1:
+        import numpy as np
         from jax.experimental import multihost_utils
 
+        ok = multihost_utils.broadcast_one_to_all(
+            np.int32(0 if err is not None else 1)
+        )
         multihost_utils.sync_global_devices(f"ckpt_save_{step}")
+        if err is None and not int(ok):
+            raise CheckpointWriteError(
+                step, path,
+                RuntimeError("checkpoint write failed on process 0"),
+            )
+    if err is not None:
+        raise err
     return path
 
 
-def _write_host_state(state, model_dir: str, step: int, compress: bool) -> str:
+def _write_host_state(state, model_dir: str, step: int, compress: bool,
+                      faults=None) -> str:
     """Host-side half of a save (state already device_get). Runs on the
-    async writer thread; everything here is pure host CPU + disk."""
+    async writer thread; everything here is pure host CPU + disk.
+
+    The CRC trailer is computed over the final on-disk bytes (after the
+    codec, if any) and written inside the same atomic tmp+replace, so a
+    reader can never observe a trailer that does not match its payload.
+    Disk I/O retries transient OSErrors; an injected write fault
+    (resilience.FaultPlan.ckpt_write_fail) fails every attempt so the
+    failure genuinely surfaces."""
     os.makedirs(model_dir, exist_ok=True)
     path = checkpoint_path(model_dir, step)
     data = serialization.to_bytes(state)
@@ -99,10 +184,19 @@ def _write_host_state(state, model_dir: str, step: int, compress: bool) -> str:
         # itemsize 4: the payload is dominated by f32 leaves, so a 4-byte
         # shuffle feeds the LZ stage well; correctness is itemsize-agnostic
         data = COMPRESSED_MAGIC + codec.compress_bytes(data, itemsize=4)
-    tmp = path + ".tmp"
-    with open(tmp, "wb") as f:
-        f.write(data)
-    os.replace(tmp, path)
+    data += TRAILER_MAGIC + struct.pack("<I", zlib.crc32(data))
+
+    def write():
+        if faults is not None:
+            faults.maybe_fail_ckpt_write(step)
+        tmp = path + ".tmp"
+        with open(tmp, "wb") as f:
+            f.write(data)
+        os.replace(tmp, path)
+
+    retry_io(write, desc=f"checkpoint write step {step}")
+    if faults is not None:
+        faults.maybe_corrupt_ckpt(path, step)
     return path
 
 
@@ -116,42 +210,199 @@ class AsyncCheckpointer:
     Trainer.train calls it before returning, keeping the reference's
     synchronous visible behavior (a checkpoint exists when training is
     done) without its per-step stall. Single writer by construction
-    (one thread), preserving the no-torn-reads guarantee."""
+    (one thread), preserving the no-torn-reads guarantee.
 
-    def __init__(self):
+    Failure handling: a background write that fails is logged — and
+    reported through `event_sink` as a structured ``ckpt_write_failed``
+    record — AT FAILURE TIME on the writer thread, then re-raised from
+    the next `save()`/`wait()` wrapped in CheckpointWriteError carrying
+    the step and path it was writing (the bare future exception said
+    neither)."""
+
+    def __init__(self, event_sink=None, faults=None):
         from concurrent.futures import ThreadPoolExecutor
 
         self._pool = ThreadPoolExecutor(max_workers=1, thread_name_prefix="ckpt")
         self._pending = None
+        self._event_sink = event_sink
+        self._faults = faults
 
     def save(self, state, model_dir: str, step: int, compress: bool = False):
         if jax.process_count() > 1:
             # multi-host: degrade to the synchronous collective save — its
             # barrier gives every process a durable-write guarantee, which
             # an async submit on process 0 alone cannot (the other
-            # processes' wait() would be a no-op on an unwritten file)
-            save_checkpoint(state, model_dir, step, compress)
+            # processes' wait() would be a no-op on an unwritten file).
+            # Same failure wrapper as the async path: the event + the
+            # step/path context are promised unconditionally.
+            self._logged(
+                lambda: save_checkpoint(state, model_dir, step, compress,
+                                        faults=self._faults),
+                model_dir, step,
+            )
             return
         host_state = _gather_host_state(state)
         self.wait()  # keep at most one write in flight
         self._pending = self._pool.submit(
-            _write_host_state, host_state, model_dir, step, compress
+            self._write_logged, host_state, model_dir, step, compress
         )
+
+    def _write_logged(self, host_state, model_dir: str, step: int,
+                      compress: bool):
+        return self._logged(
+            lambda: _write_host_state(
+                host_state, model_dir, step, compress, faults=self._faults
+            ),
+            model_dir, step,
+        )
+
+    def _logged(self, write, model_dir: str, step: int):
+        path = checkpoint_path(model_dir, step)
+        try:
+            return write()
+        except CheckpointWriteError:
+            # already wrapped: save_checkpoint's collective-outcome raise
+            # on processes 1..N-1. Process 0 owns the log line and the
+            # structured event — re-wrapping would nest the message and
+            # duplicate the JSONL record once per process.
+            raise
+        except Exception as e:
+            # report NOW (on the async path: the writer thread), not at
+            # the next wait() — by then the loop is steps ahead and the
+            # context is gone. Exception, not BaseException: a
+            # KeyboardInterrupt landing in the synchronous multi-host
+            # save must not masquerade as a ckpt_write_failed event.
+            logger.error(
+                "checkpoint write failed (step %d, %s): %s",
+                step, path, e,
+            )
+            if self._event_sink is not None:
+                try:
+                    self._event_sink({
+                        "kind": "ckpt_write_failed",
+                        "step": step,
+                        "path": path,
+                        "error": str(e),
+                    })
+                except Exception:
+                    logger.exception("ckpt_write_failed event sink raised")
+            raise CheckpointWriteError(step, path, e) from e
 
     def wait(self):
         if self._pending is not None:
-            self._pending.result()
-            self._pending = None
+            try:
+                self._pending.result()
+            finally:
+                self._pending = None
 
 
-def _read_bytes(model_dir: str, step: int) -> bytes:
-    with open(checkpoint_path(model_dir, step), "rb") as f:
-        data = f.read()
+def _read_payload(model_dir: str, step: int, read_attempts: int = 3):
+    """Read one checkpoint file and verify+strip its CRC trailer.
+
+    Returns (payload, had_trailer); the payload is still codec-compressed
+    if it was written that way. A file without the trailer is a
+    pre-resilience checkpoint and is accepted as-is — the trailer is
+    detected, never demanded, so seed-era runs/ artifacts keep loading."""
+    path = checkpoint_path(model_dir, step)
+
+    def read():
+        with open(path, "rb") as f:
+            return f.read()
+
+    data = retry_io(
+        read, desc=f"checkpoint read step {step}", attempts=read_attempts
+    )
+    if len(data) >= TRAILER_LEN and data[-TRAILER_LEN:-4] == TRAILER_MAGIC:
+        payload, (crc,) = data[:-TRAILER_LEN], struct.unpack(
+            "<I", data[-4:]
+        )
+        if zlib.crc32(payload) != crc:
+            raise CheckpointCorruptError(
+                f"CRC mismatch in {path}: stored {crc:#010x}, computed "
+                f"{zlib.crc32(payload):#010x} — the file is damaged"
+            )
+        return payload, True
+    return data, False
+
+
+def _decode_payload(data: bytes, path: str):
+    """Trailer-stripped bytes -> raw nested dicts (codec, then msgpack),
+    with decode failures (the signature of a damaged trailer-less file)
+    classified as corruption. Structure mismatches AFTER a clean restore
+    are config errors, not corruption, and propagate unchanged from the
+    from_state_dict side."""
     if data[:4] == COMPRESSED_MAGIC:
         from .ops import codec
 
-        data = codec.decompress_bytes(data[4:])
-    return data
+        try:
+            data = codec.decompress_bytes(data[4:])
+        except Exception as e:
+            raise CheckpointCorruptError(
+                f"codec decompression failed for {path}: {e}"
+            ) from e
+    try:
+        return serialization.msgpack_restore(data)
+    except (ValueError, EOFError, struct.error) as e:
+        raise CheckpointCorruptError(
+            f"cannot deserialize {path}: {e}"
+        ) from e
+    except Exception as e:
+        # msgpack's unpack exceptions don't all subclass ValueError;
+        # anything raised from its module is a damaged-bytes signature
+        if type(e).__module__.partition(".")[0] == "msgpack":
+            raise CheckpointCorruptError(
+                f"cannot deserialize {path}: {e}"
+            ) from e
+        raise
+
+
+def _restore_raw(model_dir: str, step: int):
+    data, _ = _read_payload(model_dir, step)
+    return _decode_payload(data, checkpoint_path(model_dir, step))
+
+
+def verify_checkpoint(
+    model_dir: str, step: int, read_attempts: int = 3
+) -> None:
+    """Raise CheckpointCorruptError (damaged) or OSError (unreadable) if
+    checkpoint `step` cannot be restored; return None when valid.
+
+    A CRC trailer, when present, certifies every byte of the file, so
+    the (possibly large) codec + msgpack decode is skipped — validation
+    on the evaluator's poll path must not double each checkpoint's load
+    cost. Legacy trailer-less files get the full decode: a restore is
+    the only way to detect their truncation. Callers that wrap this in
+    their own retry loop (_await_readable) pass read_attempts=1 so the
+    two backoff schedules don't multiply."""
+    data, had_trailer = _read_payload(model_dir, step, read_attempts)
+    if not had_trailer:
+        _decode_payload(data, checkpoint_path(model_dir, step))
+
+
+def quarantine_checkpoint(model_dir: str, step: int) -> str:
+    """Rename a damaged checkpoint out of the `model_step_N` namespace so
+    resume/eval stop considering it, but the bytes stay for forensics."""
+    path = checkpoint_path(model_dir, step)
+    target = path + QUARANTINE_SUFFIX
+    os.replace(path, target)
+    logger.warning("quarantined corrupt checkpoint %s -> %s", path, target)
+    return target
+
+
+def latest_valid_step(model_dir: str) -> Optional[int]:
+    """Newest step whose file passes a full integrity check, skipping
+    (but not touching) corrupt/truncated ones — read-only, so a polling
+    evaluator can call it while racing the trainer's writer."""
+    for step in reversed(available_steps(model_dir)):
+        try:
+            verify_checkpoint(model_dir, step)
+            return step
+        except (CheckpointCorruptError, OSError) as e:
+            logger.warning(
+                "checkpoint step %d is not loadable (%s); trying older",
+                step, e,
+            )
+    return None
 
 
 def load_checkpoint(target, model_dir: str, step: int):
@@ -168,11 +419,33 @@ def load_checkpoint(target, model_dir: str, step: int):
     the target has off (stored comm_state, target None) — also errors
     loudly: flax would otherwise pass the raw arrays through a None
     target silently, and dropping accumulated EF residuals would quietly
-    change the training math."""
-    raw = serialization.msgpack_restore(_read_bytes(model_dir, step))
+    change the training math.
+
+    RESETTABLE_FIELDS (guard_state) get softer treatment in BOTH
+    directions: absent from the checkpoint -> restored as the target's
+    fresh value (counters re-zeroed); present but disabled in the target
+    -> dropped. They are observability, and must never strand a
+    checkpoint the way lost EF residuals would."""
+    raw = _restore_raw(model_dir, step)
     tgt_dict = serialization.to_state_dict(target)
     if isinstance(raw, dict) and isinstance(tgt_dict, dict):
         for k, v in tgt_dict.items():
+            if k in RESETTABLE_FIELDS:
+                if v is None:
+                    # guard off now: drop whatever was stored — and fill
+                    # the key in when a pre-guard checkpoint lacks it, or
+                    # from_state_dict errors on the missing field
+                    raw[k] = None
+                elif raw.get(k) is None:
+                    # pre-guard checkpoint (key absent) or guard-off run
+                    # (stored None): restore the target's fresh counters
+                    raw[k] = v
+                elif isinstance(raw[k], dict) and isinstance(v, dict):
+                    # both sides carry state: the owning module decides
+                    # what survives the config change (e.g. the guard's
+                    # dyn-flag/loss-scale rules live in resilience/guard)
+                    raw[k] = RESETTABLE_FIELDS[k](raw[k], v)
+                continue
             if k not in raw and v is None:
                 raw[k] = None
             elif v is None and raw.get(k) is not None:
@@ -209,7 +482,7 @@ def load_checkpoint_raw(model_dir: str, step: int) -> dict:
     and placement config: it only consumes params/batch_stats/step and never
     needs to reconstruct the opt_state pytree (whose structure varies by
     --optimizer/--opt-placement)."""
-    return serialization.msgpack_restore(_read_bytes(model_dir, step))
+    return _restore_raw(model_dir, step)
 
 
 def available_steps(model_dir: str):
@@ -228,15 +501,50 @@ def latest_step(model_dir: str) -> Optional[int]:
     return steps[-1] if steps else None
 
 
+def _await_readable(model_dir: str, step: int, attempts: int,
+                    base_delay_s: float) -> bool:
+    """True once checkpoint `step` fully restores; retries both OSError
+    (NFS close-to-open visibility: listed but not yet openable) and
+    corruption (a replica still propagating) with backoff. False = gave
+    up — the caller skips the step instead of dying downstream."""
+    try:
+        retry_io(
+            # read_attempts=1: this outer loop IS the retry schedule;
+            # _read_payload's internal retry would multiply it (5 outer
+            # x 3 inner = 15 reads with compounded backoff)
+            lambda: verify_checkpoint(model_dir, step, read_attempts=1),
+            desc=f"checkpoint step {step} readability",
+            attempts=attempts,
+            base_delay_s=base_delay_s,
+            retry_on=(OSError, CheckpointCorruptError),
+        )
+        return True
+    except (OSError, CheckpointCorruptError) as e:
+        logger.warning(
+            "checkpoint step %d never became readable (%s): skipping it",
+            step, e,
+        )
+        return False
+
+
 def poll_checkpoints(
     model_dir: str,
     start_after: int = 0,
     interval_s: float = 10.0,
     timeout_s: Optional[float] = None,
+    validate: bool = True,
+    validate_attempts: int = 5,
+    validate_delay_s: float = 0.2,
 ) -> Iterator[int]:
     """Yield new checkpoint steps as they appear (evaluator's consume loop;
     parity: distributed_evaluator.py:79-88 polls every 10s). Stops when
-    `timeout_s` elapses with no new checkpoint (None = poll forever)."""
+    `timeout_s` elapses with no new checkpoint (None = poll forever).
+
+    With `validate` (default), a step visible in the directory listing
+    but not yet fully readable — slow NFS visibility, or a corrupt file —
+    is retried with backoff and then SKIPPED rather than yielded once and
+    left to crash the consumer (the reference evaluator's torch.load
+    simply died there)."""
     seen = start_after
     waited = 0.0
     while True:
@@ -245,6 +553,10 @@ def poll_checkpoints(
             waited = 0.0
             for s in fresh:
                 seen = s
+                if validate and not _await_readable(
+                    model_dir, s, validate_attempts, validate_delay_s
+                ):
+                    continue
                 yield s
             continue
         if timeout_s is not None and waited >= timeout_s:

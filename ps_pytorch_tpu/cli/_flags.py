@@ -67,6 +67,19 @@ def add_train_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--kill-threshold", type=float, default=7.0)
     parser.add_argument("--comm-type", type=str, default="Bcast")
     parser.add_argument("--enable-gpu", type=str, default="")
+    # resilience (host side)
+    parser.add_argument("--straggler-storm-n", type=int,
+                        default=d.straggler_storm_n,
+                        help="consecutive straggler steps that collapse "
+                             "into one straggler_storm event")
+    parser.add_argument("--max-consecutive-skips", type=int,
+                        default=d.max_consecutive_skips,
+                        help="abort after this many consecutive non-finite "
+                             "(skipped) steps; 0 = never abort")
+    parser.add_argument("--fault-plan", type=str, default=None,
+                        help="deterministic fault injection: a JSON "
+                             "FaultPlan object or @path to one (also via "
+                             "PS_TPU_FAULTS env); see resilience/faults.py")
     return parser
 
 
@@ -105,6 +118,18 @@ def add_ps_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--dcn-hosts", type=int, default=1,
                         help=">1 = hierarchical dp over a (hosts x chips) "
                              "hybrid mesh (ICI reduce first, one DCN hop)")
+    # resilience (device side)
+    parser.add_argument("--no-nonfinite-guard", action="store_true",
+                        help="disable the device-side non-finite gradient "
+                             "guard (skip-step on NaN/Inf; default on)")
+    parser.add_argument("--dynamic-loss-scale", action="store_true",
+                        help="grow-on-success/back-off-on-overflow loss "
+                             "scaling (needs a --compress-grad mode)")
+    parser.add_argument("--loss-scale-init", type=float, default=2.0 ** 15)
+    parser.add_argument("--loss-scale-growth-interval", type=int,
+                        default=2000,
+                        help="consecutive good steps before the loss "
+                             "scale doubles")
     parser.add_argument("--coordinator-address", type=str, default=None,
                         help="host:port for multi-host DCN rendezvous")
     parser.add_argument("--num-processes", type=int, default=None)
@@ -141,6 +166,9 @@ def train_config_from(args: argparse.Namespace) -> TrainConfig:
         straggler_threshold_s=(
             args.kill_threshold if args.mode != "normal" else None
         ),
+        straggler_storm_n=args.straggler_storm_n,
+        max_consecutive_skips=args.max_consecutive_skips,
+        fault_plan=args.fault_plan,
     )
 
 
@@ -161,4 +189,8 @@ def ps_config_from(args: argparse.Namespace, num_workers: int) -> PSConfig:
         bn_mode=args.bn_mode,
         grad_accum_steps=args.grad_accum_steps,
         dcn_hosts=args.dcn_hosts,
+        nonfinite_guard=not args.no_nonfinite_guard,
+        dynamic_loss_scale=args.dynamic_loss_scale,
+        loss_scale_init=args.loss_scale_init,
+        loss_scale_growth_interval=args.loss_scale_growth_interval,
     )

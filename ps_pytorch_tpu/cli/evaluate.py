@@ -105,7 +105,9 @@ class Evaluator:
     ) -> dict:
         results = {}
         if once:
-            step = ckpt.latest_step(self.model_dir)
+            # newest VALID step: a corrupt/truncated latest file must not
+            # kill the one-shot evaluation when an older good one exists
+            step = ckpt.latest_valid_step(self.model_dir)
             if step is None:
                 logger.info("no checkpoints in %s", self.model_dir)
                 return results

@@ -145,9 +145,11 @@ def main(argv=None) -> dict:
 
     results = {}
     if args.once:
-        from ..checkpoint import latest_step
+        from ..checkpoint import latest_valid_step
 
-        step = latest_step(args.model_dir)
+        # newest VALID step: a corrupt/truncated latest file must not
+        # kill the one-shot evaluation when an older good one exists
+        step = latest_valid_step(args.model_dir)
         if step is None:
             raise FileNotFoundError(f"no checkpoints in {args.model_dir}")
         steps = [step]

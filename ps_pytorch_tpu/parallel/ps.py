@@ -48,6 +48,11 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from ..models import apply_model
 from ..ops.metrics import accuracy, cross_entropy_loss
 from ..ops.quantize import dequantize_int8, quantize_int8
+from ..resilience.guard import (
+    init_guard_state,
+    tree_all_finite,
+    update_guard_state,
+)
 from .collectives import aggregate_gradients, aggregation_mask
 from .mesh import WORKER_AXIS
 
@@ -99,6 +104,19 @@ class PSConfig:
     # (mesh.make_hybrid_mesh): axis_name is promoted to the axis tuple so
     # aggregation reduces over ICI within a host before crossing DCN once
     dcn_hosts: int = 1
+    # non-finite gradient guard (resilience/guard.py): one int32 pmin
+    # agrees mesh-wide that every worker's gradients are finite; a bad
+    # step applies the identity update instead of the optimizer, counted
+    # in GuardState (checkpointed with the state). Default ON — the int8
+    # wire formats make overflow/NaN a when, not an if.
+    nonfinite_guard: bool = True
+    # dynamic loss scaling (grow-on-success / back-off-on-overflow) for
+    # the compressed wire formats; requires the guard (the skip IS the
+    # overflow handler) and a compress mode (uncompressed f32 psum has
+    # f32 headroom and doesn't need it)
+    dynamic_loss_scale: bool = False
+    loss_scale_init: float = 2.0 ** 15
+    loss_scale_growth_interval: int = 2000
 
     def __post_init__(self):
         if self.dcn_hosts > 1:
@@ -126,6 +144,25 @@ class PSConfig:
             raise ValueError(f"bad quant_rounding {self.quant_rounding!r}")
         if self.error_feedback and self.compress in (None, "none"):
             raise ValueError("error_feedback needs a compress mode")
+        if self.dynamic_loss_scale:
+            if self.compress in (None, "none"):
+                raise ValueError("dynamic_loss_scale needs a compress mode")
+            if not self.nonfinite_guard:
+                raise ValueError(
+                    "dynamic_loss_scale needs nonfinite_guard (the skip "
+                    "step is the overflow back-off trigger)"
+                )
+        if self.loss_scale_growth_interval < 1:
+            raise ValueError(
+                f"bad loss_scale_growth_interval "
+                f"{self.loss_scale_growth_interval}"
+            )
+        if self.loss_scale_init <= 0.0:
+            # scale 0 zeroes the loss and the unscale divides by it: every
+            # step overflows and the guard aborts blaming the DATA
+            raise ValueError(
+                f"bad loss_scale_init {self.loss_scale_init} (must be > 0)"
+            )
         if (
             self.compress == "int8_2round"
             and self.opt_placement == "sharded"
@@ -164,6 +201,11 @@ class PSTrainState:
     # (cfg.error_feedback); None otherwise — checkpointed with the state
     # so resume keeps the accumulated compression error
     comm_state: Any = None
+    # non-finite guard counters + live loss scale (resilience.GuardState,
+    # cfg.nonfinite_guard); None when the guard is off. Checkpointed, but
+    # resettable: checkpoint.load_checkpoint re-zeros it when restoring a
+    # pre-guard checkpoint (the counters are observability, not math)
+    guard_state: Any = None
 
 
 def _flat_padded_size(params) -> int:
@@ -227,12 +269,19 @@ def init_ps_state(
                 ),
                 params,
             )
+    guard_state = None
+    if cfg.nonfinite_guard:
+        guard_state = init_guard_state(
+            cfg.loss_scale_init if cfg.dynamic_loss_scale else 1.0,
+            dynamic=cfg.dynamic_loss_scale,
+        )
     return PSTrainState(
         step=jnp.zeros([], jnp.int32),
         params=params,
         opt_state=opt_state,
         batch_stats=batch_stats,
         comm_state=comm_state,
+        guard_state=guard_state,
     )
 
 
@@ -246,6 +295,7 @@ def state_specs(cfg: PSConfig):
         opt_state=opt_spec,
         batch_stats=bs_spec,
         comm_state=P(cfg.axis_name),  # worker-stacked residuals (if any)
+        guard_state=P(),  # scalar counters, replicated
     )
 
 
@@ -262,6 +312,7 @@ def shard_state(state: PSTrainState, mesh: Mesh, cfg: PSConfig) -> PSTrainState:
         opt_state=put(state.opt_state, specs.opt_state),
         batch_stats=put(state.batch_stats, specs.batch_stats),
         comm_state=put(state.comm_state, specs.comm_state),
+        guard_state=put(state.guard_state, specs.guard_state),
     )
 
 
@@ -359,6 +410,7 @@ def make_ps_train_step(
     mesh: Mesh,
     preprocess: Optional[Callable[[jax.Array, jax.Array], jax.Array]] = None,
     donate: bool = True,
+    faults=None,
 ):
     """Build the jitted SPMD train step: (state, batch, key) -> (state, metrics).
 
@@ -366,6 +418,16 @@ def make_ps_train_step(
     num_workers; `key` drives augmentation/dropout (per-worker folded) and the
     random-K aggregation mask (shared). One call = one global step of the
     reference protocol (master step N + all workers' iteration N together).
+
+    With cfg.nonfinite_guard the step carries its own defense: a per-worker
+    all-finite reduction over the gradients, one int32 pmin for mesh
+    consensus (4 B on the wire, no host transfer), and a `jnp.where` select
+    that turns the whole state update into the identity on a bad step —
+    the guard decision never leaves the device.
+
+    `faults` (resilience.FaultPlan) bakes deterministic NaN/Inf gradient
+    injection into the compiled step at the planned global steps — the
+    chaos harness that proves the guard end-to-end.
     """
     axis, n = cfg.axis_name, cfg.num_workers
     specs = state_specs(cfg)
@@ -377,13 +439,22 @@ def make_ps_train_step(
     )
 
     def worker_fn(step_idx, params, opt_state, batch_stats, comm_state,
-                  images, labels, key):
+                  guard_state, images, labels, key):
         w = lax.axis_index(axis)
         k_step = jax.random.fold_in(key, step_idx)
         k_mask = jax.random.fold_in(k_step, 0xA66)
         k_aug, k_drop = jax.random.split(jax.random.fold_in(k_step, w + 1))
 
         x = preprocess(k_aug, images) if preprocess else images.astype(jnp.float32)
+
+        params_in, opt_in, bs_in_raw, comm_in = (
+            params, opt_state, batch_stats, comm_state
+        )
+        scale = (
+            guard_state.scale
+            if cfg.nonfinite_guard and cfg.dynamic_loss_scale
+            else None
+        )
 
         if cfg.opt_placement == "sharded":
             opt_state = tree_map(lambda a: a[0], opt_state)
@@ -394,9 +465,19 @@ def make_ps_train_step(
                 logits, new_bs = apply_model(
                     model, p, bs_in, xi, train=True, dropout_rng=kd
                 )
-                return cross_entropy_loss(logits, yi), (logits, new_bs)
+                loss = cross_entropy_loss(logits, yi)
+                if scale is not None:
+                    loss = loss * scale
+                return loss, (logits, new_bs)
 
-            return jax.value_and_grad(loss_fn, has_aux=True)(params)
+            (loss, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            if scale is not None:
+                # unscale immediately: everything downstream (EF residual,
+                # quantization, the finite check) sees true-magnitude
+                # gradients; overflow shows up as inf surviving the divide
+                loss = loss / scale
+                g = tree_map(lambda t: t / scale, g)
+            return (loss, aux), g
 
         if cfg.grad_accum_steps > 1:
             a = cfg.grad_accum_steps
@@ -435,6 +516,27 @@ def make_ps_train_step(
         else:
             (loss, (logits, new_bs)), grads = fwd_bwd(bs, x, labels, k_drop)
             prec1, prec5 = accuracy(logits, labels, (1, 5))
+
+        if faults is not None and (faults.nan_grads or faults.inf_grads):
+            # deterministic chaos: poison the gradients at the planned
+            # global steps (host numbering: step_idx is pre-increment)
+            host_step = step_idx + 1
+            for steps, val in ((faults.nan_grads, jnp.nan),
+                               (faults.inf_grads, jnp.inf)):
+                if steps:
+                    hit = jnp.any(host_step == jnp.asarray(steps, jnp.int32))
+                    grads = tree_map(
+                        lambda g, h=hit, v=val: jnp.where(h, v, g), grads
+                    )
+
+        finite = None
+        if cfg.nonfinite_guard:
+            # mesh-wide agreement on "every worker's gradients are
+            # finite": one int32 pmin — 4 bytes on the interconnect, no
+            # host transfer, and every worker takes the same branch
+            finite = lax.pmin(
+                tree_all_finite(grads).astype(jnp.int32), axis
+            ) > 0
 
         new_comm = comm_state
         quant_key = (
@@ -489,7 +591,34 @@ def make_ps_train_step(
         metrics = lax.pmean(
             {"loss": loss, "prec1": prec1, "prec5": prec5}, axis
         )
-        return params, new_opt, out_bs, new_comm, metrics
+        new_guard = guard_state
+        if cfg.nonfinite_guard:
+            # skip-step: a non-finite step becomes the identity update —
+            # params, optimizer state, BN stats, and EF residuals all keep
+            # their pre-step values bit-identically; only the guard
+            # counters (and the loss scale) advance. The aggregation
+            # collectives still ran (NaNs flow through them harmlessly),
+            # so the per-step wire accounting is step-invariant.
+            def sel(new, old):
+                return tree_map(
+                    lambda a, b: jnp.where(finite, a, b), new, old
+                )
+
+            params = sel(params, params_in)
+            new_opt = sel(new_opt, opt_in)
+            out_bs = sel(out_bs, bs_in_raw)
+            new_comm = sel(new_comm, comm_in)
+            new_guard = update_guard_state(
+                guard_state, finite, cfg.dynamic_loss_scale,
+                cfg.loss_scale_growth_interval,
+            )
+            # ride the metrics dict the host already fetches once per log
+            # window — the guard adds no per-step host transfer
+            metrics["skipped_steps"] = new_guard.skipped.astype(jnp.float32)
+            metrics["skip_streak"] = new_guard.consec.astype(jnp.float32)
+            if cfg.dynamic_loss_scale:
+                metrics["loss_scale"] = new_guard.scale
+        return params, new_opt, out_bs, new_comm, new_guard, metrics
 
     mapped = jax.shard_map(
         worker_fn,
@@ -500,6 +629,7 @@ def make_ps_train_step(
             specs.opt_state,
             specs.batch_stats,
             specs.comm_state,
+            specs.guard_state,
             P(axis),
             P(axis),
             P(),
@@ -509,21 +639,25 @@ def make_ps_train_step(
             specs.opt_state,
             specs.batch_stats,
             specs.comm_state,
+            specs.guard_state,
             P(),
         ),
         check_vma=False,
     )
 
     def step(state: PSTrainState, batch, key):
-        params, opt_state, batch_stats, comm_state, metrics = mapped(
-            state.step,
-            state.params,
-            state.opt_state,
-            state.batch_stats,
-            state.comm_state,
-            batch["image"],
-            batch["label"],
-            key,
+        params, opt_state, batch_stats, comm_state, guard_state, metrics = (
+            mapped(
+                state.step,
+                state.params,
+                state.opt_state,
+                state.batch_stats,
+                state.comm_state,
+                state.guard_state,
+                batch["image"],
+                batch["label"],
+                key,
+            )
         )
         new_state = PSTrainState(
             step=state.step + 1,
@@ -531,6 +665,7 @@ def make_ps_train_step(
             opt_state=opt_state,
             batch_stats=batch_stats,
             comm_state=comm_state,
+            guard_state=guard_state,
         )
         return new_state, metrics
 

@@ -1,0 +1,155 @@
+"""Deterministic fault injection: the chaos half of the resilience layer.
+
+A ``FaultPlan`` names global step numbers (1-based, the numbers the log
+lines and checkpoints carry) at which to inject a failure, so every run
+of a chaos test replays the identical schedule — no timers, no
+randomness. Injectable faults and the defense each one proves:
+
+  nan_grads / inf_grads  device-side non-finite gradients -> the guard
+                         skips the step (params identical, counter up)
+  slow_steps (slow_s)    a host stall inside the step phase -> trips the
+                         straggler watchdog / storm escalation
+  ckpt_write_fail        checkpoint write raises EIO (every attempt at
+                         that step) -> AsyncCheckpointer's structured
+                         ckpt_write_failed event + contextual error
+  ckpt_corrupt           the written checkpoint file is truncated on
+                         disk -> CRC verify fails, --resume quarantines
+                         it and falls back to the previous valid step
+  sigterm                the process SIGTERMs itself at a step boundary
+                         -> graceful-stop consensus, final checkpoint,
+                         clean --resume
+
+The plan comes from ``--fault-plan`` (a JSON object or ``@path`` to one)
+or the ``PS_TPU_FAULTS`` env var, so subprocess tests and tools/smoke.sh
+drive it without touching code. Gradient faults are baked into the
+jitted step as constants (parallel/ps.py); host faults hook the trainer
+loop and the checkpoint writer.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import errno
+import json
+import os
+import signal
+import time
+from typing import Optional, Tuple
+
+FAULTS_ENV = "PS_TPU_FAULTS"
+
+_KNOWN_KEYS = {
+    "nan_grads", "inf_grads", "slow_steps", "slow_s",
+    "ckpt_write_fail", "ckpt_corrupt", "sigterm",
+}
+
+
+def _steps(raw, key) -> Tuple[int, ...]:
+    if raw is None:
+        return ()
+    if not isinstance(raw, (list, tuple)):
+        raise ValueError(f"fault plan {key!r} must be a list of steps")
+    for s in raw:
+        # bool is an int subclass: [true] would silently poison step 1
+        if isinstance(s, bool) or not isinstance(s, int):
+            raise ValueError(
+                f"fault plan {key!r} steps must be integers, got {s!r}"
+            )
+    return tuple(sorted(raw))
+
+
+@dataclasses.dataclass
+class FaultPlan:
+    """A deterministic schedule of injected failures, keyed by step."""
+
+    nan_grads: Tuple[int, ...] = ()
+    inf_grads: Tuple[int, ...] = ()
+    slow_steps: Tuple[int, ...] = ()
+    slow_s: float = 1.5
+    ckpt_write_fail: Tuple[int, ...] = ()
+    ckpt_corrupt: Tuple[int, ...] = ()
+    sigterm: Optional[int] = None
+
+    def __post_init__(self):
+        self._sigterm_fired = False
+
+    # ------------------------------------------------------------- parsing
+    @classmethod
+    def parse(cls, spec: str) -> "FaultPlan":
+        """Parse a JSON object (or ``@path`` to a JSON file)."""
+        if spec.startswith("@"):
+            with open(spec[1:]) as f:
+                spec = f.read()
+        raw = json.loads(spec)
+        if not isinstance(raw, dict):
+            raise ValueError("fault plan must be a JSON object")
+        unknown = sorted(set(raw) - _KNOWN_KEYS)
+        if unknown:
+            raise ValueError(
+                f"unknown fault plan key(s) {unknown}; known: "
+                f"{sorted(_KNOWN_KEYS)}"
+            )
+        sig = raw.get("sigterm")
+        if sig is not None and (
+            isinstance(sig, bool) or not isinstance(sig, int)
+        ):
+            # every other fault key is a step LIST; catch the natural
+            # '{"sigterm": [5]}' analogy with a real error, not a
+            # TypeError traceback from int()
+            raise ValueError(
+                f"fault plan 'sigterm' must be a single step number "
+                f"(the process can only die once), got {sig!r}"
+            )
+        slow_s = float(raw.get("slow_s", cls.slow_s))
+        if slow_s < 0:
+            # fail at parse time like every other malformed field, not as
+            # a time.sleep ValueError mid-run at the injection step
+            raise ValueError(
+                f"fault plan 'slow_s' must be >= 0, got {slow_s}"
+            )
+        return cls(
+            nan_grads=_steps(raw.get("nan_grads"), "nan_grads"),
+            inf_grads=_steps(raw.get("inf_grads"), "inf_grads"),
+            slow_steps=_steps(raw.get("slow_steps"), "slow_steps"),
+            slow_s=slow_s,
+            ckpt_write_fail=_steps(raw.get("ckpt_write_fail"),
+                                   "ckpt_write_fail"),
+            ckpt_corrupt=_steps(raw.get("ckpt_corrupt"), "ckpt_corrupt"),
+            sigterm=(None if raw.get("sigterm") is None
+                     else int(raw["sigterm"])),
+        )
+
+    # --------------------------------------------------------- host hooks
+    def maybe_sleep(self, step: int) -> None:
+        """Stall the host inside the step phase (straggler injection)."""
+        if step in self.slow_steps:
+            time.sleep(self.slow_s)
+
+    def maybe_sigterm(self, step: int) -> None:
+        """Deliver SIGTERM to this process once, at the planned step."""
+        if self.sigterm == step and not self._sigterm_fired:
+            self._sigterm_fired = True
+            os.kill(os.getpid(), signal.SIGTERM)
+
+    def maybe_fail_ckpt_write(self, step: int) -> None:
+        """Raise EIO from inside the checkpoint writer. Persistent for
+        the step (every retry attempt fails), so the failure surfaces
+        instead of being absorbed by the I/O retry."""
+        if step in self.ckpt_write_fail:
+            raise OSError(
+                errno.EIO, f"injected checkpoint write failure (step {step})"
+            )
+
+    def maybe_corrupt_ckpt(self, path: str, step: int) -> None:
+        """Truncate the just-written checkpoint to half its size —
+        simulated on-disk corruption the CRC trailer must catch."""
+        if step in self.ckpt_corrupt:
+            size = os.path.getsize(path)
+            with open(path, "r+b") as f:
+                f.truncate(max(size // 2, 1))
+
+
+def resolve_fault_plan(spec: Optional[str]) -> Optional[FaultPlan]:
+    """Explicit spec first (CLI flag), else the env var, else None."""
+    spec = spec or os.environ.get(FAULTS_ENV) or None
+    return FaultPlan.parse(spec) if spec else None

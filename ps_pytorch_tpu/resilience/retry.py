@@ -1,0 +1,43 @@
+"""Bounded exponential-backoff retry for checkpoint I/O.
+
+Checkpoints cross a shared filesystem (the reference's NFS train_dir;
+gcsfuse on a pod — checkpoint.py docstrings), which is exactly where
+transient EIO/ESTALE lives. Retries are deterministic (fixed delays, no
+jitter: the chaos suite needs reproducible schedules) and bounded; the
+last failure propagates unchanged so callers keep the real errno."""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Callable, Tuple, Type, TypeVar
+
+T = TypeVar("T")
+
+logger = logging.getLogger("ps_pytorch_tpu")
+
+
+def retry_io(
+    fn: Callable[[], T],
+    desc: str,
+    attempts: int = 3,
+    base_delay_s: float = 0.05,
+    retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+) -> T:
+    """Call ``fn()`` up to ``attempts`` times, sleeping base*2^k between
+    tries. Only ``retry_on`` exceptions are retried (default: OSError —
+    corruption is NOT transient and must not be retried into)."""
+    for attempt in range(attempts):
+        try:
+            return fn()
+        except retry_on as e:
+            if attempt == attempts - 1:
+                raise
+            delay = base_delay_s * (2 ** attempt)
+            logger.warning(
+                "transient I/O failure (%s), attempt %d/%d, retrying in "
+                "%.2fs: %s",
+                desc, attempt + 1, attempts, delay, e,
+            )
+            time.sleep(delay)
+    raise AssertionError("unreachable")  # pragma: no cover

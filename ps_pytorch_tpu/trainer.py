@@ -46,6 +46,7 @@ from .parallel import (
     shard_batch,
     shard_state,
 )
+from .resilience import resolve_fault_plan
 from .utils import PhaseTimer, format_eval_line, format_iter_line, get_logger
 
 logger = get_logger()
@@ -110,6 +111,18 @@ class TrainConfig:
     # there it was meant to kill slow workers; under SPMD there is nothing
     # to kill, so the live semantics are detection + structured warning)
     straggler_threshold_s: Optional[float] = None
+    # watchdog escalation: this many CONSECUTIVE straggler steps collapse
+    # into one structured `straggler_storm` event (per-step warnings are
+    # suppressed until the storm breaks — N slow steps is a condition,
+    # not N incidents)
+    straggler_storm_n: int = 3
+    # non-finite guard abort: raise after this many consecutive skipped
+    # steps (0 = never abort — count and log only). The guard itself is
+    # PSConfig.nonfinite_guard; this is the host-side tripwire.
+    max_consecutive_skips: int = 8
+    # deterministic fault injection: a JSON FaultPlan ('@path' to read a
+    # file), resilience/faults.py; PS_TPU_FAULTS env var when unset here
+    fault_plan: Optional[str] = None
 
 
 class Trainer:
@@ -117,9 +130,27 @@ class Trainer:
 
     def __init__(self, tcfg: TrainConfig, pcfg: PSConfig, dataset: Optional[Dataset] = None):
         self.tcfg, self.pcfg = tcfg, pcfg
+        if tcfg.straggler_storm_n < 1:
+            # 0 would silently swallow BOTH the per-step straggler events
+            # (streak < n never true) and the storm event (streak == n
+            # never true) — reject it instead of losing observability
+            raise ValueError(
+                f"straggler_storm_n must be >= 1, got "
+                f"{tcfg.straggler_storm_n} (1 = escalate immediately; "
+                f"use a large value to effectively disable storms)"
+            )
         self._stop_requested = False
         # straggler watchdog event counter (observable --mode action)
         self.straggler_steps = 0
+        # storm escalation state (straggler_storm_n consecutive slow steps)
+        self.straggler_storms = 0
+        self._straggler_streak = 0
+        # non-finite guard: skip count already reported to the host (the
+        # device-side truth rides the metrics dict, fetched per window)
+        self._skipped_seen = 0
+        self.faults = resolve_fault_plan(tcfg.fault_plan)
+        if self.faults is not None:
+            logger.warning("fault injection ACTIVE: %s", self.faults)
         self.dataset = dataset or prepare_data(
             tcfg.dataset, root=tcfg.data_root, allow_synthetic=tcfg.allow_synthetic
         )
@@ -159,13 +190,17 @@ class Trainer:
         pre_train = make_preprocessor(tcfg.dataset, train=True)
         pre_eval = make_preprocessor(tcfg.dataset, train=False)
         self._train_step = make_ps_train_step(
-            self.model, self.tx, pcfg, self.mesh, preprocess=pre_train
+            self.model, self.tx, pcfg, self.mesh, preprocess=pre_train,
+            faults=self.faults,
         )
         self._eval_step = make_ps_eval_step(
             self.model, pcfg, self.mesh, preprocess=pre_eval
         )
         self._key = jax.random.key(tcfg.seed + 1)
-        self._ckpt = ckpt.AsyncCheckpointer()
+        self._ckpt = ckpt.AsyncCheckpointer(
+            event_sink=lambda rec: append_metrics_line(tcfg.metrics_file, rec),
+            faults=self.faults,
+        )
         logger.info(
             "model %s (%d params), dataset %s%s, %d workers",
             tcfg.network,
@@ -177,15 +212,177 @@ class Trainer:
 
     # ------------------------------------------------------------------ resume
     def try_resume(self) -> Optional[int]:
-        """Restore the newest checkpoint from train_dir, if any."""
-        step = ckpt.latest_step(self.tcfg.train_dir)
-        if step is None:
+        """Restore the newest VALID checkpoint from train_dir, if any.
+
+        A corrupt/truncated file (CRC trailer mismatch, torn bytes) is
+        quarantined — renamed `*.corrupt`, out of the model_step_N
+        namespace — and the next older checkpoint is tried: a damaged
+        latest checkpoint costs one eval_freq window of progress, not the
+        run. Transient read errors (already retried with backoff inside
+        the read) skip the file WITHOUT quarantining it. Structure
+        mismatches (e.g. comm_state for a disabled feature) still raise:
+        they are configuration errors, not damage.
+
+        Multi-host: the step is chosen ONCE (process 0 walks the list)
+        and broadcast, because a file torn on only some replicas of a
+        shared dir would otherwise send hosts down different fallbacks —
+        and JAX never cross-checks replicated values, so the run would
+        continue silently divergent."""
+        steps = ckpt.available_steps(self.tcfg.train_dir)
+        if jax.process_count() > 1:
+            return self._try_resume_multihost(steps)
+        if not steps:
             return None
         target = jax.device_get(self.state)
-        restored = ckpt.load_checkpoint(target, self.tcfg.train_dir, step)
+        for step in reversed(steps):
+            try:
+                restored = ckpt.load_checkpoint(
+                    target, self.tcfg.train_dir, step
+                )
+            except ckpt.CheckpointCorruptError as e:
+                self._quarantine(step, e)
+                continue
+            except OSError as e:
+                logger.warning(
+                    "resume: checkpoint step %d unreadable (%s); trying "
+                    "older (file left in place)", step, e,
+                )
+                continue
+            self.state = shard_state(restored, self.mesh, self.pcfg)
+            self._sync_guard_baseline()
+            logger.info(
+                "resumed from %s",
+                ckpt.checkpoint_path(self.tcfg.train_dir, step),
+            )
+            return step
+        return None
+
+    def _sync_guard_baseline(self) -> None:
+        """A restored GuardState carries the LIFETIME skip count — seed
+        the host's already-reported watermark from it, or the first
+        metrics fetch of a healthy resumed run re-reports the old skips
+        as a fresh grad_skip event."""
+        if self.state.guard_state is not None:
+            self._skipped_seen = int(
+                jax.device_get(self.state.guard_state.skipped)
+            )
+
+    def _quarantine(self, step: int, err: BaseException) -> None:
+        logger.warning(
+            "resume: checkpoint step %d is corrupt (%s); quarantining "
+            "and falling back", step, err,
+        )
+        quarantined = ckpt.quarantine_checkpoint(self.tcfg.train_dir, step)
+        append_metrics_line(
+            self.tcfg.metrics_file,
+            {"kind": "ckpt_quarantined", "step": step,
+             "path": quarantined, "error": str(err)},
+        )
+
+    def _try_resume_multihost(self, steps) -> Optional[int]:
+        """Mesh-consensus resume: process 0 picks the newest step that
+        passes an integrity check (quarantining corrupt ones — one
+        renamer, so no os.replace race), the choice is broadcast, and
+        every process restores that SAME step. A host whose own replica
+        then fails the agreed load raises loudly — a crashed process
+        beats silently divergent replicated state."""
+        from jax.experimental import multihost_utils
+
+        chosen = -1
+        if jax.process_index() == 0:
+            for step in reversed(steps):
+                try:
+                    ckpt.verify_checkpoint(self.tcfg.train_dir, step)
+                    chosen = step
+                    break
+                except ckpt.CheckpointCorruptError as e:
+                    self._quarantine(step, e)
+                except OSError as e:
+                    logger.warning(
+                        "resume: checkpoint step %d unreadable (%s); "
+                        "trying older (file left in place)", step, e,
+                    )
+        chosen = int(multihost_utils.broadcast_one_to_all(np.int32(chosen)))
+        if chosen < 0:
+            return None
+        target = jax.device_get(self.state)
+        restored = ckpt.load_checkpoint(target, self.tcfg.train_dir, chosen)
         self.state = shard_state(restored, self.mesh, self.pcfg)
-        logger.info("resumed from %s", ckpt.checkpoint_path(self.tcfg.train_dir, step))
-        return step
+        self._sync_guard_baseline()
+        logger.info(
+            "resumed from %s (mesh-consensus choice)",
+            ckpt.checkpoint_path(self.tcfg.train_dir, chosen),
+        )
+        return chosen
+
+    # ----------------------------------------------------------- guard (host)
+    def _guard_check(self, m: dict, step_no: int, abort: bool = True) -> None:
+        """Host half of the non-finite gradient guard. Runs wherever the
+        metrics dict is already on host (log window / backpressure sync —
+        the guard itself never forces a transfer): emits one structured
+        `grad_skip` event per window that saw new skips, and aborts once
+        the device-side skip streak crosses max_consecutive_skips — at
+        that point the optimizer is the identity and "training" is a very
+        expensive sleep; the operator should resume from the last good
+        checkpoint with a smaller lr / different data shard."""
+        if "skipped_steps" not in m:
+            return
+        skipped, streak = int(m["skipped_steps"]), int(m["skip_streak"])
+        if skipped > self._skipped_seen:
+            logger.warning(
+                "non-finite gradients: %d step(s) skipped so far "
+                "(current streak %d) — params were NOT updated on those",
+                skipped, streak,
+            )
+            rec = {
+                "kind": "grad_skip",
+                "step": step_no,
+                "skipped_steps": skipped,
+                "skip_streak": streak,
+            }
+            if "loss_scale" in m:
+                rec["loss_scale"] = float(m["loss_scale"])
+            append_metrics_line(self.tcfg.metrics_file, rec)
+            self._skipped_seen = skipped
+        if not abort:
+            return
+        k = self.tcfg.max_consecutive_skips
+        if k > 0 and streak >= k:
+            raise RuntimeError(
+                f"aborting at step {step_no}: {streak} consecutive steps "
+                f"had non-finite gradients (threshold {k}) — every one "
+                f"was skipped, so params are stuck at step "
+                f"{step_no - streak}. Training has diverged or the input "
+                f"shard is corrupt; resume from the last valid checkpoint "
+                f"with --resume after fixing the cause."
+            )
+
+    def _maybe_end_storm(self, last_slow_step: int) -> None:
+        """Close an open straggler storm with ONE structured event
+        carrying the storm's true length. The storm-start event is
+        emitted at streak == storm_n (so its `consecutive` is always
+        exactly storm_n) and per-step records are suppressed while it
+        lasts — without a closing record the storm's extent would be
+        unrecoverable from the JSONL."""
+        t = self.tcfg
+        if self._straggler_streak < t.straggler_storm_n:
+            return
+        logger.warning(
+            "straggler storm cleared: %d consecutive slow steps "
+            "(steps %d-%d)",
+            self._straggler_streak,
+            last_slow_step - self._straggler_streak + 1,
+            last_slow_step,
+        )
+        append_metrics_line(
+            t.metrics_file,
+            {
+                "kind": "straggler_storm_end",
+                "step": last_slow_step,
+                "start_step": last_slow_step - self._straggler_streak + 1,
+                "consecutive": self._straggler_streak,
+            },
+        )
 
     # ------------------------------------------------------------ graceful stop
     def request_stop(self) -> None:
@@ -347,17 +544,29 @@ class Trainer:
                         self.state, metrics = self._train_step(
                             self.state, sharded, self._key
                         )
+                        if self.faults is not None:
+                            # injected host stall, inside the timed phase
+                            # so the watchdog sees it as a real slow step
+                            self.faults.maybe_sleep(step_no + 1)
                         if t.straggler_threshold_s is not None:
                             # the watchdog times real step walltime, not
                             # dispatch — an intentional per-step barrier,
                             # only when the watchdog is armed
                             jax.block_until_ready(metrics)
                     step_no += 1
+                    if self.faults is not None:
+                        # injected preemption: SIGTERM ourselves at the
+                        # planned step boundary; the installed handler
+                        # raises the stop flag and _stop_consensus below
+                        # turns it into a graceful checkpointed stop
+                        self.faults.maybe_sigterm(step_no)
                     window_steps += 1
-                    unsynced = (
-                        0 if t.straggler_threshold_s is not None
-                        else unsynced + 1
-                    )
+                    # counts even with the watchdog's per-step barrier:
+                    # block_until_ready syncs but never FETCHES, and the
+                    # guard's host half (skip events + the abort) needs
+                    # values — the backpressure block below is what keeps
+                    # it live when log windows don't fetch
+                    unsynced += 1
                     if (
                         t.straggler_threshold_s is not None
                         and timer.total > t.straggler_threshold_s
@@ -373,21 +582,56 @@ class Trainer:
                         # kill; slow steps indicate input stalls or host
                         # interference instead.)
                         self.straggler_steps += 1
-                        logger.warning(
-                            "straggler step: Step: %d took %.4fs (threshold %.4fs)",
-                            step_no,
-                            timer.total,
-                            t.straggler_threshold_s,
-                        )
-                        append_metrics_line(
-                            t.metrics_file,
-                            {
-                                "kind": "straggler",
-                                "step": step_no,
-                                "time_cost": round(timer.total, 6),
-                                "threshold": t.straggler_threshold_s,
-                            },
-                        )
+                        self._straggler_streak += 1
+                        if self._straggler_streak < t.straggler_storm_n:
+                            logger.warning(
+                                "straggler step: Step: %d took %.4fs (threshold %.4fs)",
+                                step_no,
+                                timer.total,
+                                t.straggler_threshold_s,
+                            )
+                            append_metrics_line(
+                                t.metrics_file,
+                                {
+                                    "kind": "straggler",
+                                    "step": step_no,
+                                    "time_cost": round(timer.total, 6),
+                                    "threshold": t.straggler_threshold_s,
+                                },
+                            )
+                        elif self._straggler_streak == t.straggler_storm_n:
+                            # escalation: N consecutive slow steps is one
+                            # CONDITION, not N incidents — emit a single
+                            # storm event and go quiet until it breaks
+                            # (straggler_steps keeps counting throughout)
+                            self.straggler_storms += 1
+                            logger.warning(
+                                "straggler storm: %d consecutive slow steps "
+                                "(through step %d, threshold %.4fs) — "
+                                "suppressing per-step warnings until it "
+                                "clears",
+                                self._straggler_streak,
+                                step_no,
+                                t.straggler_threshold_s,
+                            )
+                            append_metrics_line(
+                                t.metrics_file,
+                                {
+                                    "kind": "straggler_storm",
+                                    "step": step_no,
+                                    "start_step": (
+                                        step_no - t.straggler_storm_n + 1
+                                    ),
+                                    "consecutive": self._straggler_streak,
+                                    "threshold": t.straggler_threshold_s,
+                                },
+                            )
+                    elif t.straggler_threshold_s is not None:
+                        # a fast step breaks the streak: if a storm was
+                        # open, close its window (last slow step was the
+                        # previous one)
+                        self._maybe_end_storm(step_no - 1)
+                        self._straggler_streak = 0
                     if t.log_interval > 0 and (
                         step_no % t.log_interval == 0 or step_no == 1
                     ):
@@ -427,11 +671,21 @@ class Trainer:
                                 **{k: float(v) for k, v in metrics.items()},
                             },
                         )
+                        # guard host half piggybacks on the window fetch:
+                        # skip events + the consecutive-skip abort. Runs
+                        # AFTER the window's train record lands (unlike
+                        # the backpressure block below) so an aborting
+                        # window is still in the JSONL
+                        self._guard_check(metrics, step_no)
                     if unsynced >= max_unsynced:
-                        # backpressure barrier (reached only when neither
-                        # the watchdog nor a log window synced recently,
-                        # e.g. log_interval=0 or very large)
-                        jax.block_until_ready(metrics)
+                        # backpressure barrier + periodic fetch (reached
+                        # when no log window fetched recently, e.g.
+                        # log_interval=0 or very large): bounds dispatch
+                        # run-ahead and keeps the guard abort live when
+                        # logging is off — with the watchdog armed the
+                        # buffers are already ready, so this is fetch-only
+                        metrics = jax.device_get(metrics)  # psl: sync-ok
+                        self._guard_check(metrics, step_no)
                         unsynced = 0
                     if (
                         t.save_checkpoints
@@ -474,8 +728,17 @@ class Trainer:
             # caller observes the outcome
             self._ckpt.wait()
         out = {k: float(v) for k, v in metrics.items()}
+        if out:
+            # final drain of the guard's host half: a skip in a trailing
+            # partial window (or a whole run shorter than log_interval)
+            # still lands its grad_skip event in the JSONL. No abort —
+            # the run is already over, the counter just needs reporting.
+            self._guard_check(out, step_no, abort=False)
+            # a storm still open at run end gets its closing event too
+            self._maybe_end_storm(step_no)
         if self.straggler_steps:
             out["straggler_steps"] = float(self.straggler_steps)
+            out["straggler_storms"] = float(self.straggler_storms)
         return out
 
     # ---------------------------------------------------------------- validate

@@ -1,0 +1,694 @@
+"""Chaos suite: every resilience defense proven end-to-end by injecting
+the failure it exists for (inject -> skip/fallback/resume -> converge).
+
+Deterministic on the 8-device virtual CPU mesh: faults are keyed by
+global step number (resilience/faults.py), never by timers or
+randomness. Covers the device-side non-finite guard (skip-step identity,
+counters, K-consecutive abort, dynamic loss scaling), checkpoint CRC
+trailers + quarantine + fallback resume + trailer-less backward compat,
+I/O retry, the AsyncCheckpointer failure context, the polling
+evaluator's unreadable-checkpoint retry, the straggler watchdog fed by
+an injected slow step, and the SIGTERM graceful-stop path as a real
+subprocess."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from ps_pytorch_tpu import checkpoint as ckpt
+from ps_pytorch_tpu.data import make_synthetic
+from ps_pytorch_tpu.models import build_model
+from ps_pytorch_tpu.optim import sgd
+from ps_pytorch_tpu.parallel import (
+    PSConfig,
+    init_ps_state,
+    make_ps_train_step,
+    shard_batch,
+    shard_state,
+)
+from ps_pytorch_tpu.resilience import FaultPlan, resolve_fault_plan, retry_io
+from ps_pytorch_tpu.trainer import TrainConfig, Trainer
+
+N = 8
+
+
+@pytest.fixture()
+def tiny_ds():
+    return make_synthetic("MNIST", train_size=128, test_size=32, seed=1)
+
+
+def _tcfg(tmp_path, **kw):
+    base = dict(
+        network="LeNet",
+        dataset="MNIST",
+        batch_size=16,
+        test_batch_size=32,
+        epochs=4,
+        max_steps=4,
+        lr=0.01,
+        momentum=0.9,
+        eval_freq=2,
+        log_interval=1,
+        train_dir=str(tmp_path / "models"),
+    )
+    base.update(kw)
+    return TrainConfig(**base)
+
+
+def _leaves_equal(a, b):
+    la = jax.tree_util.tree_leaves(a)
+    lb = jax.tree_util.tree_leaves(b)
+    return all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+def _batch(seed=0, n=16):
+    rng = np.random.RandomState(seed)
+    return {
+        "image": rng.randint(0, 255, (n, 28, 28, 1)).astype(np.uint8),
+        "label": rng.randint(0, 10, (n,)).astype(np.int32),
+    }
+
+
+# ---------------------------------------------------------------- fault plan
+def test_fault_plan_parse_and_resolution(tmp_path, monkeypatch):
+    plan = FaultPlan.parse('{"nan_grads": [5, 2], "sigterm": 7, "slow_s": 0.5}')
+    assert plan.nan_grads == (2, 5)  # sorted
+    assert plan.sigterm == 7 and plan.slow_s == 0.5
+    p = tmp_path / "plan.json"
+    p.write_text('{"inf_grads": [3]}')
+    assert FaultPlan.parse(f"@{p}").inf_grads == (3,)
+    with pytest.raises(ValueError, match="unknown fault plan key"):
+        FaultPlan.parse('{"nan_gradz": [1]}')
+    # sigterm is a single step, not a list like every other key — the
+    # natural analogy must fail with a real message, not a TypeError
+    with pytest.raises(ValueError, match="sigterm.*single step"):
+        FaultPlan.parse('{"sigterm": [5]}')
+    # bool is an int subclass: '{"sigterm": true}' / '[true]' must not
+    # silently become step 1
+    with pytest.raises(ValueError, match="sigterm.*single step"):
+        FaultPlan.parse('{"sigterm": true}')
+    with pytest.raises(ValueError, match="must be integers"):
+        FaultPlan.parse('{"nan_grads": [true]}')
+    # negative sleep would otherwise crash mid-run at the injection step
+    with pytest.raises(ValueError, match="slow_s"):
+        FaultPlan.parse('{"slow_steps": [2], "slow_s": -1}')
+    # env fallback, explicit spec wins
+    monkeypatch.setenv("PS_TPU_FAULTS", '{"nan_grads": [9]}')
+    assert resolve_fault_plan(None).nan_grads == (9,)
+    assert resolve_fault_plan('{"nan_grads": [1]}').nan_grads == (1,)
+    monkeypatch.delenv("PS_TPU_FAULTS")
+    assert resolve_fault_plan(None) is None
+
+
+# ------------------------------------------------------------ guard (device)
+def test_skipped_step_is_identity(mesh):
+    """An injected NaN (step 2) / Inf (step 3) leaves params AND optimizer
+    state bit-identical; the skip counters advance on device."""
+    cfg = PSConfig(num_workers=N)
+    model, tx = build_model("LeNet"), sgd(0.1, momentum=0.9)
+    state = shard_state(
+        init_ps_state(model, tx, cfg, jax.random.key(0), (28, 28, 1)),
+        mesh, cfg,
+    )
+    plan = FaultPlan.parse('{"nan_grads": [2], "inf_grads": [3]}')
+    step = make_ps_train_step(model, tx, cfg, mesh, faults=plan)
+
+    state, m = step(state, shard_batch(_batch(0), mesh, cfg), jax.random.key(1))
+    healthy = jax.device_get(state)  # pre-donation read of the good state
+    for inj_step, key in ((2, 2), (3, 3)):
+        state, m = step(
+            state, shard_batch(_batch(key), mesh, cfg), jax.random.key(key)
+        )
+        m = jax.device_get(m)  # psl: sync-ok
+        got = jax.device_get(state)  # psl: sync-ok
+        assert _leaves_equal(got.params, healthy.params), inj_step
+        assert _leaves_equal(got.opt_state, healthy.opt_state), inj_step
+        assert float(m["skipped_steps"]) == float(inj_step - 1)
+        assert float(m["skip_streak"]) == float(inj_step - 1)
+    # step 4 is healthy again: streak resets, params move
+    state, m = step(state, shard_batch(_batch(4), mesh, cfg), jax.random.key(4))
+    m = jax.device_get(m)  # psl: sync-ok
+    assert float(m["skip_streak"]) == 0.0
+    assert float(m["skipped_steps"]) == 2.0
+    assert not _leaves_equal(jax.device_get(state).params, healthy.params)
+
+
+def test_guard_off_lets_nan_through(mesh):
+    """nonfinite_guard=False documents what the default saves you from:
+    one bad step and the params are poisoned."""
+    cfg = PSConfig(num_workers=N, nonfinite_guard=False)
+    model, tx = build_model("LeNet"), sgd(0.1)
+    state = shard_state(
+        init_ps_state(model, tx, cfg, jax.random.key(0), (28, 28, 1)),
+        mesh, cfg,
+    )
+    plan = FaultPlan.parse('{"nan_grads": [1]}')
+    step = make_ps_train_step(model, tx, cfg, mesh, faults=plan)
+    state, m = step(state, shard_batch(_batch(), mesh, cfg), jax.random.key(1))
+    assert "skipped_steps" not in m
+    leaf = np.asarray(jax.tree_util.tree_leaves(jax.device_get(state.params))[0])
+    assert np.isnan(leaf).any()
+
+
+def test_dynamic_loss_scale_backoff_and_growth(mesh):
+    """Overflow halves the scale; growth_interval consecutive good steps
+    double it back (grow-on-success / back-off-on-overflow)."""
+    cfg = PSConfig(
+        num_workers=N, compress="int8", dynamic_loss_scale=True,
+        loss_scale_init=1024.0, loss_scale_growth_interval=2,
+    )
+    model, tx = build_model("LeNet"), sgd(0.01)
+    state = shard_state(
+        init_ps_state(model, tx, cfg, jax.random.key(0), (28, 28, 1)),
+        mesh, cfg,
+    )
+    plan = FaultPlan.parse('{"inf_grads": [2]}')
+    step = make_ps_train_step(model, tx, cfg, mesh, faults=plan)
+    scales = []
+    for i in range(1, 6):
+        state, m = step(
+            state, shard_batch(_batch(i), mesh, cfg), jax.random.key(i)
+        )
+        scales.append(float(jax.device_get(m)["loss_scale"]))  # psl: sync-ok
+    # step1 good (streak 1), step2 overflow -> 512, steps 3-4 good ->
+    # growth fires at streak 2 -> 1024, step5 good (streak 1 again)
+    assert scales == [1024.0, 512.0, 512.0, 1024.0, 1024.0], scales
+
+
+def test_loss_scale_validation():
+    with pytest.raises(ValueError, match="needs a compress mode"):
+        PSConfig(num_workers=2, dynamic_loss_scale=True)
+    with pytest.raises(ValueError, match="nonfinite_guard"):
+        PSConfig(num_workers=2, compress="int8", dynamic_loss_scale=True,
+                 nonfinite_guard=False)
+    # scale 0 would zero the loss and divide gradients by 0: every step
+    # overflows and the guard aborts blaming the data, not the config
+    with pytest.raises(ValueError, match="loss_scale_init"):
+        PSConfig(num_workers=2, compress="int8", dynamic_loss_scale=True,
+                 loss_scale_init=0.0)
+
+
+# ------------------------------------------------------------- guard (host)
+def test_trainer_skips_nan_step_and_logs_event(tmp_path, tiny_ds):
+    mfile = tmp_path / "m.jsonl"
+    tcfg = _tcfg(tmp_path, metrics_file=str(mfile),
+                 fault_plan='{"nan_grads": [3]}')
+    out = Trainer(tcfg, PSConfig(num_workers=2), dataset=tiny_ds).train()
+    assert out["skipped_steps"] == 1.0
+    assert np.isfinite(out["loss"])  # training continued past the skip
+    events = [json.loads(l) for l in open(mfile)]
+    skips = [e for e in events if e["kind"] == "grad_skip"]
+    assert len(skips) == 1 and skips[0]["skipped_steps"] == 1
+
+
+def test_skip_in_trailing_partial_window_still_logs_event(tmp_path, tiny_ds):
+    """A run shorter than log_interval never hits a window fetch — the
+    final metrics drain must still land the grad_skip event in the JSONL
+    (without the consecutive-skip abort: the run is already over)."""
+    mfile = tmp_path / "m.jsonl"
+    tcfg = _tcfg(tmp_path, metrics_file=str(mfile), log_interval=100,
+                 eval_freq=0, fault_plan='{"nan_grads": [3]}')
+    out = Trainer(tcfg, PSConfig(num_workers=2), dataset=tiny_ds).train()
+    assert out["skipped_steps"] == 1.0
+    events = [json.loads(l) for l in open(mfile)]
+    skips = [e for e in events if e["kind"] == "grad_skip"]
+    assert len(skips) == 1 and skips[0]["skipped_steps"] == 1
+
+
+def test_trainer_aborts_after_consecutive_skips(tmp_path, tiny_ds):
+    tcfg = _tcfg(
+        tmp_path, max_steps=20, eval_freq=0, max_consecutive_skips=3,
+        fault_plan='{"nan_grads": [2, 3, 4, 5, 6, 7, 8, 9]}',
+    )
+    with pytest.raises(RuntimeError, match="3 consecutive steps"):
+        Trainer(tcfg, PSConfig(num_workers=2), dataset=tiny_ds).train()
+
+
+def test_abort_fires_with_watchdog_armed_and_logging_off(tmp_path, tiny_ds):
+    """The abort must stay live in EVERY flag combination: with the
+    straggler watchdog armed (per-step block_until_ready but no fetch)
+    and log_interval=0 (no window fetch), the backpressure fetch — every
+    32 steps — is the only host look at the counters, and it must still
+    trip max_consecutive_skips."""
+    tcfg = _tcfg(
+        tmp_path, max_steps=40, eval_freq=0, log_interval=0,
+        save_checkpoints=False, straggler_threshold_s=1e9,
+        max_consecutive_skips=3,
+        fault_plan=json.dumps(
+            {"nan_grads": list(range(2, 41))}
+        ),
+    )
+    with pytest.raises(RuntimeError, match="consecutive steps"):
+        Trainer(tcfg, PSConfig(num_workers=2), dataset=tiny_ds).train()
+
+
+def test_guard_counters_survive_resume(tmp_path, tiny_ds):
+    """GuardState is part of the checkpointed state: a resumed run keeps
+    the lifetime skip count instead of silently re-zeroing it."""
+    tcfg = _tcfg(tmp_path, fault_plan='{"nan_grads": [3]}')
+    pcfg = PSConfig(num_workers=2)
+    Trainer(tcfg, pcfg, dataset=tiny_ds).train()
+
+    tcfg2 = _tcfg(tmp_path, max_steps=6, resume=True)
+    tr2 = Trainer(tcfg2, pcfg, dataset=tiny_ds)
+    out = tr2.train()
+    assert int(jax.device_get(tr2.state.step)) == 6
+    assert out["skipped_steps"] == 1.0  # carried over, not reset
+
+
+def test_resume_with_guard_toggled(tmp_path, tiny_ds):
+    """Checkpoints cross the guard on/off boundary in both directions:
+    guard_state is observability, never a resume blocker (trailer-less
+    pre-PR checkpoints take the same reset path)."""
+    tcfg = _tcfg(tmp_path)
+    Trainer(
+        tcfg, PSConfig(num_workers=2, nonfinite_guard=False), dataset=tiny_ds
+    ).train()
+    # guard-off checkpoint -> guard-on resume (counters reset to zero)
+    tcfg2 = _tcfg(tmp_path, max_steps=5, resume=True)
+    tr = Trainer(tcfg2, PSConfig(num_workers=2), dataset=tiny_ds)
+    assert tr.try_resume() == 4
+    assert int(jax.device_get(tr.state.guard_state.skipped)) == 0
+    # guard-on checkpoint -> guard-off resume (counters dropped)
+    tr.train()
+    tcfg3 = _tcfg(tmp_path, max_steps=6, resume=True)
+    tr3 = Trainer(
+        tcfg3, PSConfig(num_workers=2, nonfinite_guard=False), dataset=tiny_ds
+    )
+    assert tr3.try_resume() == 5
+    assert tr3.state.guard_state is None
+
+
+def test_resume_into_dynamic_loss_scale_reinits_scale(tmp_path):
+    """A dynamic-off checkpoint stores scale 1.0; resuming with
+    --dynamic-loss-scale must start from the configured init, not spend
+    ~growth_interval*log2(init) steps regrowing from 1.0. A genuinely
+    dynamic stored scale (!= 1.0) is preserved."""
+    model, tx = build_model("LeNet"), sgd(0.01)
+    d = str(tmp_path)
+    state_off = jax.device_get(init_ps_state(
+        model, tx, PSConfig(num_workers=N), jax.random.key(0), (28, 28, 1)
+    ))
+    ckpt._write_host_state(state_off, d, 3, compress=False)
+    cfg_on = PSConfig(num_workers=N, compress="int8",
+                      dynamic_loss_scale=True, loss_scale_init=1024.0)
+    target = jax.device_get(init_ps_state(
+        model, tx, cfg_on, jax.random.key(0), (28, 28, 1)
+    ))
+    restored = ckpt.load_checkpoint(target, d, 3)
+    assert float(restored.guard_state.scale) == 1024.0  # re-inited
+    # a live dynamic scale (backed off to 512) survives the round-trip
+    state_live = state_off.replace(
+        guard_state=state_off.guard_state.replace(
+            scale=np.float32(512.0), dyn=np.int32(1)
+        )
+    )
+    ckpt._write_host_state(state_live, d, 5, compress=False)
+    restored = ckpt.load_checkpoint(target, d, 5)
+    assert float(restored.guard_state.scale) == 512.0  # kept, not re-inited
+    # the ambiguous case the dyn flag exists for: a dynamic run that
+    # legitimately backed off to MIN_LOSS_SCALE stores scale 1.0 just
+    # like a dynamic-off run — the flag (not scale==1.0) must decide
+    state_floor = state_off.replace(
+        guard_state=state_off.guard_state.replace(
+            scale=np.float32(1.0), dyn=np.int32(1)
+        )
+    )
+    ckpt._write_host_state(state_floor, d, 9, compress=False)
+    restored = ckpt.load_checkpoint(target, d, 9)
+    assert float(restored.guard_state.scale) == 1.0  # kept, not re-inited
+
+
+# --------------------------------------------------------- checkpoint format
+def test_checkpoint_has_crc_trailer_and_roundtrips(tmp_path, tiny_ds):
+    tcfg = _tcfg(tmp_path, max_steps=2)
+    tr = Trainer(tcfg, PSConfig(num_workers=2), dataset=tiny_ds)
+    tr.train()
+    path = ckpt.checkpoint_path(tcfg.train_dir, 2)
+    with open(path, "rb") as f:
+        data = f.read()
+    assert data[-ckpt.TRAILER_LEN:-4] == ckpt.TRAILER_MAGIC
+    ckpt.verify_checkpoint(tcfg.train_dir, 2)  # no raise
+    state = jax.device_get(tr.state)
+    restored = ckpt.load_checkpoint(state, tcfg.train_dir, 2)
+    assert _leaves_equal(state.params, restored.params)
+
+
+def test_trailerless_checkpoint_still_loads(tmp_path, tiny_ds):
+    """Pre-resilience files (no CRC trailer) keep loading — existing
+    runs/ artifacts and in-flight --resume dirs are not invalidated."""
+    from flax import serialization
+
+    tcfg = _tcfg(tmp_path, max_steps=2)
+    tr = Trainer(tcfg, PSConfig(num_workers=2), dataset=tiny_ds)
+    tr.train()
+    state = jax.device_get(tr.state)
+    legacy = serialization.to_bytes(state)
+    os.makedirs(tcfg.train_dir, exist_ok=True)
+    with open(ckpt.checkpoint_path(tcfg.train_dir, 7), "wb") as f:
+        f.write(legacy)  # written the pre-PR way: no trailer
+    assert ckpt.latest_valid_step(tcfg.train_dir) == 7
+    restored = ckpt.load_checkpoint(state, tcfg.train_dir, 7)
+    assert _leaves_equal(state.params, restored.params)
+
+
+def test_pre_guard_checkpoint_loads_with_guard_on_or_off(tmp_path, tiny_ds):
+    """A pre-PR checkpoint has NO guard_state key at all (not a stored
+    None) — it must load whether the resuming run has the guard on
+    (fresh counters) or off (field stays None), per the trailer-less
+    backward-compat acceptance criterion."""
+    from flax import serialization
+
+    tcfg = _tcfg(tmp_path, max_steps=2)
+    tr = Trainer(tcfg, PSConfig(num_workers=2), dataset=tiny_ds)
+    tr.train()
+    state = jax.device_get(tr.state)
+    legacy_dict = dict(serialization.to_state_dict(state))
+    del legacy_dict["guard_state"]  # what a pre-PR writer produced
+    with open(ckpt.checkpoint_path(tcfg.train_dir, 9), "wb") as f:
+        f.write(serialization.to_bytes(legacy_dict))
+
+    restored = ckpt.load_checkpoint(state, tcfg.train_dir, 9)  # guard on
+    assert int(restored.guard_state.skipped) == 0  # fresh counters
+    off = Trainer(
+        _tcfg(tmp_path, max_steps=3, resume=True),
+        PSConfig(num_workers=2, nonfinite_guard=False), dataset=tiny_ds,
+    )
+    assert off.try_resume() == 9  # guard off: must not crash either
+    assert off.state.guard_state is None
+
+
+def test_corruption_detected_bitflip_and_truncation(tmp_path, tiny_ds):
+    tcfg = _tcfg(tmp_path, max_steps=2)
+    Trainer(tcfg, PSConfig(num_workers=2), dataset=tiny_ds).train()
+    path = ckpt.checkpoint_path(tcfg.train_dir, 2)
+    good = open(path, "rb").read()
+    # bit flip mid-payload: length preserved, CRC catches it
+    flipped = bytearray(good)
+    flipped[len(flipped) // 2] ^= 0xFF
+    with open(path, "wb") as f:
+        f.write(bytes(flipped))
+    with pytest.raises(ckpt.CheckpointCorruptError, match="CRC mismatch"):
+        ckpt.verify_checkpoint(tcfg.train_dir, 2)
+    # truncation: the trailer is gone, msgpack classification catches it
+    with open(path, "wb") as f:
+        f.write(good[: len(good) // 2])
+    with pytest.raises(ckpt.CheckpointCorruptError):
+        ckpt.verify_checkpoint(tcfg.train_dir, 2)
+    assert ckpt.latest_valid_step(tcfg.train_dir) is None
+
+
+def test_resume_quarantines_corrupt_latest_and_falls_back(tmp_path, tiny_ds):
+    """The acceptance scenario: corruption is INJECTED at write time
+    (fault plan), --resume quarantines the damaged newest checkpoint and
+    restores the previous valid step, then trains onward."""
+    mfile = tmp_path / "m.jsonl"
+    tcfg = _tcfg(tmp_path, fault_plan='{"ckpt_corrupt": [4]}',
+                 metrics_file=str(mfile))
+    pcfg = PSConfig(num_workers=2)
+    Trainer(tcfg, pcfg, dataset=tiny_ds).train()
+    assert ckpt.available_steps(tcfg.train_dir) == [2, 4]
+
+    tcfg2 = _tcfg(tmp_path, max_steps=6, resume=True,
+                  metrics_file=str(mfile))
+    tr2 = Trainer(tcfg2, pcfg, dataset=tiny_ds)
+    assert tr2.try_resume() == 2  # fell back past the corrupt step 4
+    assert os.path.exists(
+        ckpt.checkpoint_path(tcfg.train_dir, 4) + ckpt.QUARANTINE_SUFFIX
+    )
+    assert 4 not in ckpt.available_steps(tcfg.train_dir)
+    tr2.train()  # tcfg2.resume re-runs try_resume; idempotent on step 2
+    assert int(jax.device_get(tr2.state.step)) == 6
+    events = [json.loads(l) for l in open(mfile)]
+    assert any(e["kind"] == "ckpt_quarantined" and e["step"] == 4
+               for e in events)
+
+
+# ------------------------------------------------------------------ I/O path
+def test_retry_io_retries_transient_only():
+    calls = {"n": 0}
+
+    def flaky():
+        calls["n"] += 1
+        if calls["n"] < 3:
+            raise OSError(5, "injected EIO")
+        return "ok"
+
+    assert retry_io(flaky, desc="t", base_delay_s=0.001) == "ok"
+    assert calls["n"] == 3
+
+    def always_bad():
+        calls["n"] += 1
+        raise OSError(5, "persistent")
+
+    calls["n"] = 0
+    with pytest.raises(OSError):
+        retry_io(always_bad, desc="t", attempts=3, base_delay_s=0.001)
+    assert calls["n"] == 3
+
+    def config_error():
+        calls["n"] += 1
+        raise ValueError("not transient")
+
+    calls["n"] = 0
+    with pytest.raises(ValueError):
+        retry_io(config_error, desc="t", base_delay_s=0.001)
+    assert calls["n"] == 1  # no retry on non-IO errors
+
+
+def test_async_checkpointer_failure_event_and_context(tmp_path):
+    events = []
+    plan = FaultPlan.parse('{"ckpt_write_fail": [2]}')
+    writer = ckpt.AsyncCheckpointer(event_sink=events.append, faults=plan)
+    state = {"params": {"w": np.arange(4, dtype=np.float32)}}
+    writer.save(state, str(tmp_path), 2)
+    with pytest.raises(ckpt.CheckpointWriteError) as ei:
+        writer.wait()
+    # the wrapped error carries the step and path the write was for
+    assert ei.value.step == 2
+    assert ei.value.path == ckpt.checkpoint_path(str(tmp_path), 2)
+    assert "step 2" in str(ei.value)
+    # the structured event fired at failure time, before wait()
+    assert len(events) == 1 and events[0]["kind"] == "ckpt_write_failed"
+    assert events[0]["step"] == 2 and "path" in events[0]
+    # a failed wait() clears the pending future: next save works
+    writer.save(state, str(tmp_path), 3)
+    writer.wait()
+    assert ckpt.available_steps(str(tmp_path)) == [3]
+
+
+def test_logged_does_not_double_wrap_write_error(tmp_path):
+    """save_checkpoint's collective-outcome raise on processes 1..N-1 is
+    already a CheckpointWriteError: the _logged wrapper must pass it
+    through untouched — re-wrapping nests the message and duplicates the
+    ckpt_write_failed event once per process (process 0 owns it)."""
+    events = []
+    writer = ckpt.AsyncCheckpointer(event_sink=events.append)
+    orig = ckpt.CheckpointWriteError(2, "p", RuntimeError("x"))
+
+    def boom():
+        raise orig
+
+    with pytest.raises(ckpt.CheckpointWriteError) as ei:
+        writer._logged(boom, str(tmp_path), 2)
+    assert ei.value is orig  # not nested
+    assert events == []  # no duplicate event
+
+
+def test_poll_checkpoints_skips_bad_and_recovers_late_file(tmp_path):
+    d = str(tmp_path)
+    state = {"params": {"w": np.arange(4, dtype=np.float32)}}
+    ckpt._write_host_state(state, d, 2, compress=False)
+    # step 4: persistently corrupt -> retried, then skipped (the
+    # reference evaluator's torch.load would have died here)
+    ckpt._write_host_state(state, d, 4, compress=False)
+    p4 = ckpt.checkpoint_path(d, 4)
+    with open(p4, "r+b") as f:
+        f.truncate(os.path.getsize(p4) // 2)
+    got = list(ckpt.poll_checkpoints(
+        d, interval_s=0.01, timeout_s=0.0,
+        validate_attempts=2, validate_delay_s=0.01,
+    ))
+    assert got == [2]
+    # step 6: appears corrupt (slow NFS visibility), becomes valid while
+    # the poller is backing off -> yielded after retry, not skipped
+    ckpt._write_host_state(state, d, 6, compress=False)
+    p6 = ckpt.checkpoint_path(d, 6)
+    good6 = open(p6, "rb").read()
+    with open(p6, "wb") as f:
+        f.write(good6[: len(good6) // 2])
+
+    def heal():
+        with open(p6, "wb") as f:
+            f.write(good6)
+
+    t = threading.Timer(0.3, heal)
+    t.start()
+    try:
+        got = list(ckpt.poll_checkpoints(
+            d, start_after=4, interval_s=0.01, timeout_s=0.0,
+            validate_attempts=6, validate_delay_s=0.1,
+        ))
+    finally:
+        t.cancel()
+    assert got == [6]
+
+
+def test_await_readable_retries_at_one_layer_only(tmp_path, monkeypatch):
+    """_await_readable's outer loop IS the retry schedule: the inner
+    checkpoint read must not add its own (attempts x 3 reads with
+    compounded backoff was the bug)."""
+    from ps_pytorch_tpu.resilience import retry as retry_mod
+
+    sleeps = []
+    monkeypatch.setattr(retry_mod.time, "sleep", sleeps.append)
+    ok = ckpt._await_readable(str(tmp_path), 99, 3, 0.01)
+    assert ok is False
+    assert len(sleeps) == 2  # attempts-1 backoffs, no nested schedule
+
+
+def test_evaluator_once_skips_corrupt_latest(tmp_path, tiny_ds, monkeypatch):
+    monkeypatch.setenv("PS_TPU_DATA_DIR", str(tmp_path / "nodata"))
+    tcfg = _tcfg(tmp_path, fault_plan='{"ckpt_corrupt": [4]}')
+    Trainer(tcfg, PSConfig(num_workers=2), dataset=tiny_ds).train()
+
+    from ps_pytorch_tpu.cli.evaluate import Evaluator
+
+    ev = Evaluator("LeNet", "MNIST", tcfg.train_dir, eval_batch_size=32)
+    results = ev.run(once=True)
+    assert list(results) == [2]  # newest VALID, not newest
+    assert np.isfinite(results[2]["loss"])
+
+
+# ----------------------------------------------------------------- watchdog
+def test_injected_slow_step_trips_watchdog(tmp_path, tiny_ds):
+    mfile = tmp_path / "m.jsonl"
+    tcfg = _tcfg(
+        tmp_path, max_steps=3, save_checkpoints=False,
+        straggler_threshold_s=0.75, metrics_file=str(mfile),
+        fault_plan='{"slow_steps": [3], "slow_s": 1.5}',
+    )
+    out = Trainer(tcfg, PSConfig(num_workers=2), dataset=tiny_ds).train()
+    assert out["straggler_steps"] == 1.0
+    assert out["straggler_storms"] == 0.0
+    events = [json.loads(l) for l in open(mfile)]
+    stragglers = [e for e in events if e["kind"] == "straggler"]
+    assert [e["step"] for e in stragglers] == [3]
+
+
+def test_straggler_storm_escalation(tmp_path, tiny_ds):
+    """N consecutive straggler steps collapse into ONE structured storm
+    event (not N lines), surfaced next to straggler_steps."""
+    import logging
+
+    mfile = tmp_path / "m.jsonl"
+    tcfg = _tcfg(
+        tmp_path, max_steps=6, save_checkpoints=False,
+        straggler_threshold_s=0.0,  # every post-compile step straggles
+        straggler_storm_n=3, metrics_file=str(mfile),
+    )
+    lg = logging.getLogger("ps_pytorch_tpu")
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record.getMessage())
+
+    h = Capture()
+    lg.addHandler(h)
+    try:
+        out = Trainer(tcfg, PSConfig(num_workers=2), dataset=tiny_ds).train()
+    finally:
+        lg.removeHandler(h)
+    # 5 straggler steps (2..6), one storm starting at streak 3
+    assert out["straggler_steps"] == 5.0
+    assert out["straggler_storms"] == 1.0
+    per_step_warnings = [m for m in records if "straggler step:" in m]
+    storm_warnings = [m for m in records if "straggler storm:" in m]
+    storm_cleared = [m for m in records if "straggler storm cleared" in m]
+    assert len(per_step_warnings) == 2  # pre-storm only; storm silences
+    assert len(storm_warnings) == 1
+    assert len(storm_cleared) == 1  # run-end close of the open storm
+    events = [json.loads(l) for l in open(mfile)]
+    storms = [e for e in events if e["kind"] == "straggler_storm"]
+    assert len(storms) == 1
+    assert storms[0]["start_step"] == 2 and storms[0]["step"] == 4
+    # the storm is still open at run end: the closing event carries the
+    # TRUE length (per-step records were suppressed from streak 3 on, so
+    # without it the storm's extent is unrecoverable from the JSONL)
+    ends = [e for e in events if e["kind"] == "straggler_storm_end"]
+    assert len(ends) == 1
+    assert ends[0]["consecutive"] == 5
+    assert ends[0]["start_step"] == 2 and ends[0]["step"] == 6
+
+
+def test_straggler_storm_end_event_on_mid_run_clear(tmp_path, tiny_ds):
+    """A fast step after a storm emits the closing event with the storm's
+    span; the post-storm fast steps emit nothing."""
+    mfile = tmp_path / "m.jsonl"
+    tcfg = _tcfg(
+        tmp_path, max_steps=6, save_checkpoints=False,
+        straggler_threshold_s=0.75, straggler_storm_n=2,
+        metrics_file=str(mfile),
+        fault_plan='{"slow_steps": [2, 3, 4], "slow_s": 1.5}',
+    )
+    out = Trainer(tcfg, PSConfig(num_workers=2), dataset=tiny_ds).train()
+    assert out["straggler_steps"] == 3.0
+    assert out["straggler_storms"] == 1.0
+    events = [json.loads(l) for l in open(mfile)]
+    ends = [e for e in events if e["kind"] == "straggler_storm_end"]
+    assert len(ends) == 1
+    assert ends[0]["start_step"] == 2 and ends[0]["step"] == 4
+    assert ends[0]["consecutive"] == 3
+
+
+# ------------------------------------------------------------------ SIGTERM
+def test_sigterm_subprocess_checkpoints_then_resumes(tmp_path):
+    """Real-process preemption drill: a CLI run SIGTERMs itself at step 3
+    (fault plan), the mesh-consensus graceful stop (_stop_consensus)
+    writes a final checkpoint and exits 0; --resume finishes the
+    remaining steps from there."""
+    from tpu_env import clean_cpu_env
+
+    d = str(tmp_path / "m")
+    env = clean_cpu_env(n_devices=8)
+    env["PS_TPU_DATA_DIR"] = str(tmp_path / "nodata")  # -> synthetic data
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    proc = subprocess.run(
+        [
+            sys.executable, "-m", "ps_pytorch_tpu.cli.train",
+            "--network", "LeNet", "--dataset", "MNIST",
+            "--num-workers", "2", "--batch-size", "8",
+            "--max-steps", "30", "--eval-freq", "100",
+            "--log-interval", "1",
+            "--train-dir", d,
+            "--fault-plan", '{"sigterm": 3}',
+        ],
+        cwd=repo, env=env, capture_output=True, text=True, timeout=300,
+    )
+    assert proc.returncode == 0, proc.stderr[-2000:]
+    assert "graceful stop" in proc.stderr or "stopping after" in proc.stderr
+    assert ckpt.latest_valid_step(d) == 3  # checkpointed AT the stop step
+
+    from ps_pytorch_tpu.cli.train import main
+
+    out = main(
+        [
+            "--network", "LeNet", "--dataset", "MNIST",
+            "--num-workers", "2", "--batch-size", "8",
+            "--max-steps", "5", "--eval-freq", "100",
+            "--log-interval", "1", "--resume",
+            "--train-dir", d,
+        ]
+    )
+    assert np.isfinite(out["train"]["loss"])
+    assert ckpt.latest_valid_step(d) == 5  # continued 4,5 — not restarted

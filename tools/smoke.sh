@@ -38,6 +38,22 @@ run python -m ps_pytorch_tpu.cli.train \
 run python -m ps_pytorch_tpu.cli.evaluate \
     --network LeNet --dataset MNIST --model-dir "$TMP/cnn" --once
 
+# resilience chaos smoke (ARCHITECTURE §7d): a NaN gradient at step 4 is
+# skipped by the device-side guard, the step-6 checkpoint is corrupted on
+# disk as it lands, and the --resume run must quarantine it and restart
+# from the valid step-3 checkpoint
+run python -m ps_pytorch_tpu.cli.train \
+    --network LeNet --dataset MNIST --num-workers 8 --batch-size 64 \
+    --max-steps 6 --eval-freq 3 --log-interval 1 \
+    --fault-plan '{"nan_grads":[4],"ckpt_corrupt":[6]}' \
+    --train-dir "$TMP/chaos"
+run python -m ps_pytorch_tpu.cli.train \
+    --network LeNet --dataset MNIST --num-workers 8 --batch-size 64 \
+    --max-steps 8 --eval-freq 3 --log-interval 1 --resume \
+    --train-dir "$TMP/chaos"
+test -f "$TMP/chaos/model_step_6.corrupt" \
+    || { echo "chaos smoke: corrupt checkpoint was not quarantined"; exit 1; }
+
 run python -m ps_pytorch_tpu.cli.train_lm \
     --parallelism tp --heads 8 --dim 64 --vocab-size 64 --shard-vocab \
     --seq-len 64 --max-steps 20 --log-interval 10 --lr 0.3 \
