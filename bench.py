@@ -237,6 +237,62 @@ def _cnn_compress(default):
     return mode, tag
 
 
+# BENCH_BUCKET_BYTES selects the CNN workloads' gradient wire granularity
+# (PSConfig.bucket_bytes): unset = legacy per-leaf collectives, 0 = one
+# fused flat buffer, N = ~N-byte buckets. BENCH_AB_BUCKETING=1 instead
+# runs BOTH variants (per-leaf, then bucketed at BENCH_BUCKET_BYTES or 0)
+# and emits them in ONE record, so the fusion win is measured in the same
+# process on the same data. Either mode tags the metric key so these
+# records never shadow the canonical banked evidence.
+def _bench_bucket_bytes():
+    val = os.environ.get("BENCH_BUCKET_BYTES")
+    return None if val is None else int(val)
+
+
+def _bucket_tag() -> str:
+    if os.environ.get("BENCH_AB_BUCKETING") == "1":
+        return "_ab_bucketing"
+    bb = _bench_bucket_bytes()
+    return "" if bb is None else f"_bkt{bb}"
+
+
+def _comm_contract_entry(workload: str, compress, bucket_bytes):
+    """The committed pscheck accounting row for the PS config this CNN
+    workload trains: {config, n_collectives, wire_bytes, mesh_devices}
+    from runs/comm_contract.json, or None when the registry has no
+    matching traced entry. Contract entries are keyed by config name and
+    traced with a FIXED bucket plan (LeNet variants pin the fused plan,
+    ResNet the 4 MiB plan), so only exact bucket matches attach —
+    mislabeling a different carving would be worse than omitting."""
+    name = "ps_"
+    if workload == "resnet18":
+        name += "resnet18_"
+    name += (compress or "none") + "_replicated"
+    if bucket_bytes is not None:
+        name += "_bucketed"
+        if workload == "resnet18":
+            from ps_pytorch_tpu.check.contracts import RESNET_BUCKET_BYTES
+
+            traced_bb = RESNET_BUCKET_BYTES
+        else:
+            traced_bb = 0  # LeNet variants are traced with the fused plan
+        if bucket_bytes != traced_bb:
+            return None
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with open(os.path.join(here, "runs", "comm_contract.json")) as f:
+            data = json.load(f)
+        entry = data["configs"][name]
+    except (OSError, ValueError, KeyError):
+        return None
+    return {
+        "config": name,
+        "n_collectives": entry["n_collectives"],
+        "wire_bytes": entry["total_bytes"],
+        "mesh_devices": data.get("mesh_devices"),
+    }
+
+
 def _bench_lm(steps: int) -> tuple:
     import jax
     import jax.numpy as jnp
@@ -445,6 +501,37 @@ def _validate_env() -> None:
                 "BENCH_COMPRESS only applies to the CNN (PS) workloads; "
                 "it would be silently ignored for lm/decode"
             )
+    # AB=0 is the documented "off" value — as inert as unset, so a CI
+    # wrapper exporting it globally must not abort the lm/decode legs
+    for knob in ("BENCH_BUCKET_BYTES", "BENCH_AB_BUCKETING"):
+        val = os.environ.get(knob)
+        if knob == "BENCH_AB_BUCKETING" and val == "0":
+            val = None
+        if val is not None and os.environ.get(
+            "BENCH_WORKLOAD", "lenet"
+        ) in ("lm", "decode"):
+            raise SystemExit(
+                f"{knob} only applies to the CNN (PS) workloads; "
+                "it would be silently ignored for lm/decode"
+            )
+    if os.environ.get("BENCH_BUCKET_BYTES") is not None:
+        try:
+            bb = int(os.environ["BENCH_BUCKET_BYTES"])
+        except ValueError:
+            raise SystemExit(
+                f"BENCH_BUCKET_BYTES must be an integer >= 0, "
+                f"got {os.environ['BENCH_BUCKET_BYTES']!r}"
+            )
+        if bb < 0:
+            raise SystemExit(
+                "BENCH_BUCKET_BYTES must be >= 0 (unset it for the "
+                "legacy per-leaf wire)"
+            )
+    if os.environ.get("BENCH_AB_BUCKETING") not in (None, "0", "1"):
+        raise SystemExit(
+            f"BENCH_AB_BUCKETING must be 0 or 1, "
+            f"got {os.environ['BENCH_AB_BUCKETING']!r}"
+        )
     if os.environ.get("BENCH_WORKLOAD", "lenet") not in WORKLOADS:
         raise SystemExit(
             f"BENCH_WORKLOAD must be one of {sorted(WORKLOADS)}, "
@@ -475,7 +562,7 @@ def _success_metric() -> str:
         return f"decode_{_dec_tag()}_new_tokens_per_sec"
     metric = WORKLOADS.get(name, {}).get("metric") or f"{name}_train_throughput"
     _, ctag = _cnn_compress(WORKLOADS.get(name, {}).get("compress"))
-    return metric + ctag + _cnn_dtype_suffix()
+    return metric + ctag + _bucket_tag() + _cnn_dtype_suffix()
 
 
 def _attach_banked(rec: dict) -> None:
@@ -554,6 +641,9 @@ def main() -> None:
             "mfu": _mfu(flops, steps, elapsed, jax, n_devices=lm_dev),
             "device": device_kind,
             "timestamp": _utc_now(),
+            # comm shape rides only the PS (CNN) records — the lm
+            # workload's dp_sp scheme has no entry in the PS contract
+            "comm": None,
         }
         if chain_used > 1:  # the EFFECTIVE depth (clamped to BENCH_STEPS)
             rec["chain"] = chain_used
@@ -579,6 +669,7 @@ def main() -> None:
             "mfu": None,  # decode is KV-cache-bandwidth-bound by design
             "device": device_kind,
             "timestamp": _utc_now(),
+            "comm": None,  # serving path: no gradient wire at all
         }
         if fallback:
             _attach_banked(rec)
@@ -590,70 +681,121 @@ def main() -> None:
         return
     mesh = make_mesh(num_workers=n_dev)
     compress, _ = _cnn_compress(w["compress"])
-    cfg = PSConfig(num_workers=n_dev, compress=compress)
     # BENCH_DTYPE=bfloat16 reports the MXU-native mixed-precision config
     # (params stay f32, same as the trainer's --dtype flag); the default
     # stays f32 for like-for-like comparison with the reference's math
     import jax.numpy as jnp
 
-    _, cnn_dtype = _bench_dtype(jnp, _CNN_DTYPE_DEFAULT)
-    model = build_model(w["network"], dtype=cnn_dtype)
-    tx = sgd(0.01, momentum=0.9)
-    shape = IMAGE_SHAPES[w["dataset"]]
-    state = init_ps_state(model, tx, cfg, jax.random.key(0), shape)
-    state = shard_state(state, mesh, cfg)
-    pre = make_preprocessor(w["dataset"], train=True)
-    step = make_ps_train_step(model, tx, cfg, mesh, preprocess=pre)
-
-    ds = make_synthetic(w["dataset"], train_size=w["batch"], test_size=8, seed=0)
-    batch = {"image": ds.train_images, "label": ds.train_labels}
-    sharded = shard_batch(batch, mesh, cfg)
-    key = jax.random.key(1)
-
     from ps_pytorch_tpu.utils import host_sync
 
-    # warmup: compile + one steady-state step. Sync via HOST reads
-    # (utils/sync.py), not jax.block_until_ready: on the tunneled
-    # single-chip platform block_until_ready can return before the
-    # computation retires, silently turning the benchmark into a
-    # dispatch-rate measurement — and the loss alone does not serialize
-    # the optimizer update, which feeds only the params outputs.
-    for _ in range(2):
-        state, metrics = step(state, sharded, key)
-    host_sync(state.params, metrics)
-    flops = _step_flops(step, state, sharded, key)
-
+    _, cnn_dtype = _bench_dtype(jnp, _CNN_DTYPE_DEFAULT)
+    tx = sgd(0.01, momentum=0.9)
+    shape = IMAGE_SHAPES[w["dataset"]]
+    pre = make_preprocessor(w["dataset"], train=True)
+    ds = make_synthetic(w["dataset"], train_size=w["batch"], test_size=8, seed=0)
+    batch = {"image": ds.train_images, "label": ds.train_labels}
+    key = jax.random.key(1)
     # BENCH_STEPS trims the measured window for smoke runs on slow hosts;
     # throughput extrapolates, the baseline comparison stays per-image.
-    steps = int(os.environ.get("BENCH_STEPS", REF_STEPS))
-    k = min(_chain(), steps)  # same budget clamp as the lm path
-    if k > 1:
-        carry, elapsed, steps = _timed_chain(
-            lambda c: step(c[0], sharded, key), (state, metrics),
-            lambda c: host_sync(c[0].params, c[1]), steps, k,
-        )
-        state, metrics = carry
-    else:
-        t0 = time.perf_counter()
-        for _ in range(steps):
-            state, metrics = step(state, sharded, key)
-        # params chain step-to-step, so this host read serializes the whole
-        # measured window (forward, backward, collectives, AND update)
-        host_sync(state.params, metrics)
-        elapsed = time.perf_counter() - t0
-    loss = float(metrics["loss"])
+    req_steps = int(os.environ.get("BENCH_STEPS", REF_STEPS))
 
-    images_per_sec = steps * w["batch"] / elapsed
-    assert np.isfinite(loss), f"non-finite loss {loss}"
-    rec = {
-        "metric": _success_metric() + suffix,
-        "value": round(images_per_sec, 1),
-        "unit": "images/sec",
-        "vs_baseline": round(images_per_sec / REF_IMAGES_PER_SEC, 2),
-        "mfu": _mfu(flops, steps, elapsed, jax, n_devices=n_dev),
-        "device": device_kind,
-        "timestamp": _utc_now(),
-    }
+    def run_variant(bucket_bytes):
+        """Measure one wire granularity end to end; returns the variant's
+        sub-record plus (loss, elapsed, steps, flops, chain)."""
+        cfg = PSConfig(
+            num_workers=n_dev, compress=compress,
+            bucket_bytes=bucket_bytes,
+        )
+        model = build_model(w["network"], dtype=cnn_dtype)
+        state = init_ps_state(model, tx, cfg, jax.random.key(0), shape)
+        state = shard_state(state, mesh, cfg)
+        step = make_ps_train_step(model, tx, cfg, mesh, preprocess=pre)
+        sharded = shard_batch(batch, mesh, cfg)
+        # warmup: compile + one steady-state step. Sync via HOST reads
+        # (utils/sync.py), not jax.block_until_ready: on the tunneled
+        # single-chip platform block_until_ready can return before the
+        # computation retires, silently turning the benchmark into a
+        # dispatch-rate measurement — and the loss alone does not
+        # serialize the optimizer update, which feeds only the params.
+        for _ in range(2):
+            state, metrics = step(state, sharded, key)
+        host_sync(state.params, metrics)
+        flops = _step_flops(step, state, sharded, key)
+        steps = req_steps
+        k = min(_chain(), steps)  # same budget clamp as the lm path
+        if k > 1:
+            carry, elapsed, steps = _timed_chain(
+                lambda c: step(c[0], sharded, key), (state, metrics),
+                lambda c: host_sync(c[0].params, c[1]), steps, k,
+            )
+            state, metrics = carry
+        else:
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                state, metrics = step(state, sharded, key)
+            # params chain step-to-step, so this host read serializes the
+            # whole window (forward, backward, collectives, AND update)
+            host_sync(state.params, metrics)
+            elapsed = time.perf_counter() - t0
+        loss = float(metrics["loss"])
+        assert np.isfinite(loss), f"non-finite loss {loss}"
+        images_per_sec = steps * w["batch"] / elapsed
+        sub = {
+            "images_per_sec": round(images_per_sec, 1),
+            "step_time_s": round(elapsed / steps, 6),
+            "bucket_bytes": bucket_bytes,
+            # comm shape from the committed pscheck artifact, so the
+            # perf trajectory records the wire, not just walltime
+            "comm": _comm_contract_entry(name, compress, bucket_bytes),
+        }
+        return sub, loss, elapsed, steps, flops, k
+
+    if os.environ.get("BENCH_AB_BUCKETING") == "1":
+        # A/B leg: per-leaf vs bucketed in ONE process on the same data —
+        # the fusion win is measured, not asserted. The headline value is
+        # the bucketed variant's throughput.
+        ab_bb = _bench_bucket_bytes()
+        ab_bb = 0 if ab_bb is None else ab_bb
+        sub_leaf, *_ = run_variant(None)
+        sub_bkt, loss, elapsed, steps, flops, k = run_variant(ab_bb)
+        images_per_sec = sub_bkt["images_per_sec"]
+        rec = {
+            "metric": _success_metric() + suffix,
+            "value": images_per_sec,
+            "unit": "images/sec",
+            "vs_baseline": round(images_per_sec / REF_IMAGES_PER_SEC, 2),
+            "mfu": _mfu(flops, steps, elapsed, jax, n_devices=n_dev),
+            "device": device_kind,
+            "timestamp": _utc_now(),
+            # schema stability: every record carries "comm"; the A/B
+            # comm shapes live per-variant under ab_bucketing
+            "comm": sub_bkt["comm"],
+            "ab_bucketing": {
+                "per_leaf": sub_leaf,
+                "bucketed": sub_bkt,
+                "speedup": round(
+                    sub_bkt["images_per_sec"]
+                    / max(sub_leaf["images_per_sec"], 1e-9),
+                    3,
+                ),
+            },
+        }
+    else:
+        sub, loss, elapsed, steps, flops, k = run_variant(
+            _bench_bucket_bytes()
+        )
+        images_per_sec = sub["images_per_sec"]
+        rec = {
+            "metric": _success_metric() + suffix,
+            "value": images_per_sec,
+            "unit": "images/sec",
+            "vs_baseline": round(images_per_sec / REF_IMAGES_PER_SEC, 2),
+            "mfu": _mfu(flops, steps, elapsed, jax, n_devices=n_dev),
+            "device": device_kind,
+            "timestamp": _utc_now(),
+            "step_time_s": sub["step_time_s"],
+            "comm": sub["comm"],
+        }
     if k > 1:
         rec["chain"] = k
     if fallback:

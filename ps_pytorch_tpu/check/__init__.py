@@ -6,8 +6,10 @@ XLA is actually asked to do: it traces each scheme's real step function
 verify the communication contracts ARCHITECTURE §1-§6b claim — every
 axis carries its collective (PSC101), gradient reductions feed the
 optimizer (PSC102), compressed wires stay int8 (PSC103), per-collective
-wire bytes round-trip against runs/comm_contract.json (PSC104), and
-donation survives lowering (PSC105).
+wire bytes round-trip against runs/comm_contract.json (PSC104),
+donation survives lowering (PSC105), and bucketed wires stay fused —
+no more gradient-path collectives than the declared bucket plan allows
+(PSC106).
 
 Entry points: ``python -m ps_pytorch_tpu.check``, ``tools/check.sh``,
 and the tier-1 gate in tests/test_check.py.
@@ -17,6 +19,7 @@ from .contracts import (
     Built,
     ContractSpec,
     DonationSpec,
+    FusionSpec,
     GradReduce,
     WireAllowance,
     WirePolicy,
@@ -41,6 +44,7 @@ __all__ = [
     "Collective",
     "ContractSpec",
     "DonationSpec",
+    "FusionSpec",
     "GradReduce",
     "RULE_IDS",
     "TraceResult",

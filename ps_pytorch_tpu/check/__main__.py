@@ -3,8 +3,8 @@
 Exit codes mirror pslint: 0 = every contract holds, 1 = findings,
 2 = usage error. ``--write-contract`` regenerates the committed
 accounting artifact (runs/comm_contract.json) from the current registry
-and exits 0 — the PSC101/102/103/105 rules still run first, so a broken
-step cannot silently re-baseline itself.
+and exits 0 — the PSC101/102/103/105/106 rules still run first, so a
+broken step cannot silently re-baseline itself.
 
 Tracing needs a deterministic 8-device CPU backend; when launched as a
 real CLI in the ambient (broken-TPU-plugin) environment the process
@@ -59,7 +59,7 @@ def main(argv=None) -> int:
 
     parser = argparse.ArgumentParser(
         prog="python -m ps_pytorch_tpu.check",
-        description="jaxpr-level contract checker (rules PSC101-PSC105).",
+        description="jaxpr-level contract checker (rules PSC101-PSC106).",
     )
     parser.add_argument("--format", choices=("text", "json"),
                         default="text")
@@ -69,7 +69,7 @@ def main(argv=None) -> int:
     parser.add_argument("--write-contract", action="store_true",
                         help="regenerate the accounting artifact from the "
                              "current registry and exit 0 (PSC101/102/103/"
-                             "105 still run)")
+                             "105/106 still run)")
     parser.add_argument("--registry",
                         default="ps_pytorch_tpu.check.contracts",
                         help="module exposing get_contracts() "

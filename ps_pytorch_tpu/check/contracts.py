@@ -71,6 +71,48 @@ class DonationSpec:
     out_positions: Tuple[int, ...]
 
 
+@dataclasses.dataclass(frozen=True)
+class FusionSpec:
+    """PSC106: gradient-path collective budget for a fused/bucketed wire.
+
+    A scheme whose jaxpr emits more than
+    ``per_bucket * n_buckets + slack`` (n_buckets from the engine's own
+    ``plan_buckets``; ≈ ``ceil(payload_bytes / bucket_bytes)``)
+    reduce-kind collectives feeding the updated params fails the gate —
+    the canary for silent de-fusion (a refactor quietly going back to
+    one collective per pytree leaf).
+
+    ``payload_bytes``: f32 bytes of the gradient pytree;
+    ``bucket_bytes``: PSConfig.bucket_bytes (0/None = one fused bucket);
+    ``align``: the engine's bucket-boundary alignment in f32 elements
+    (quant block size; × num_workers for the ZeRO-1 scatter) — the
+    budget is computed by the SAME plan_buckets the wire uses, so the
+    checker can never desync from the engine's round-down carving;
+    ``per_bucket``: reduce collectives a healthy bucket legitimately
+    costs (1 for psum/psum_scatter/all_to_all schemes, 2 for the
+    hierarchical scheme's ICI + DCN all_to_all pair);
+    ``slack``: extra allowed beyond the formula (document why)."""
+
+    payload_bytes: int
+    bucket_bytes: Optional[int] = 0
+    align: int = 1
+    per_bucket: int = 1
+    slack: int = 0
+
+    @property
+    def n_buckets(self) -> int:
+        from ..parallel.buckets import plan_buckets
+
+        return plan_buckets(
+            self.payload_bytes // 4, self.bucket_bytes or 0,
+            align=self.align,
+        ).n_buckets
+
+    @property
+    def max_collectives(self) -> int:
+        return self.per_bucket * self.n_buckets + self.slack
+
+
 @dataclasses.dataclass
 class Built:
     """What a spec's builder returns: the real jitted step plus abstract
@@ -89,6 +131,7 @@ class ContractSpec:
     grad_reduce: Tuple[GradReduce, ...] = ()
     wire: Optional[WirePolicy] = None
     donation: Optional[DonationSpec] = None
+    fusion: Optional[FusionSpec] = None
 
 
 # metrics / loss pmean: a handful of f32 scalars, every scheme emits it
@@ -114,7 +157,42 @@ _FINITE_PMIN = WireAllowance(
 )
 
 
-def _lenet_ps_built(cfg) -> Built:
+# input HW shape per contract network (CIFAR-10 shapes for ResNet)
+_NETWORK_HW = {"LeNet": (28, 28, 1), "ResNet18": (32, 32, 3)}
+
+# f32 gradient payload bytes per contract network, memoized by a cheap
+# eval_shape of the real init (nothing allocates) — the PSC106 budget's
+# numerator, derived instead of hard-coded so a model edit cannot
+# silently desync the fusion contract
+_PAYLOAD_CACHE: dict = {}
+
+
+def payload_bytes(network: str) -> int:
+    if network not in _PAYLOAD_CACHE:
+        import jax
+
+        from ..models import build_model, init_model
+
+        model = build_model(network, num_classes=10)
+        params, _ = jax.eval_shape(
+            lambda: init_model(
+                model, jax.random.key(0), (1,) + _NETWORK_HW[network]
+            )
+        )
+        _PAYLOAD_CACHE[network] = 4 * sum(
+            int(_prod(l.shape)) for l in jax.tree_util.tree_leaves(params)
+        )
+    return _PAYLOAD_CACHE[network]
+
+
+def _prod(shape) -> int:
+    n = 1
+    for d in shape:
+        n *= int(d)
+    return n
+
+
+def _cnn_ps_built(cfg, network: str) -> Built:
     import jax
     import jax.numpy as jnp
     import optax
@@ -123,7 +201,8 @@ def _lenet_ps_built(cfg) -> Built:
     from ..parallel.mesh import make_hybrid_mesh, make_mesh
     from ..parallel.ps import init_ps_state, make_ps_train_step
 
-    model = build_model("LeNet", num_classes=10)
+    hw = _NETWORK_HW[network]
+    model = build_model(network, num_classes=10)
     tx = optax.sgd(0.1)
     if cfg.dcn_hosts > 1:
         mesh = make_hybrid_mesh(cfg.dcn_hosts, cfg.num_workers // cfg.dcn_hosts)
@@ -131,11 +210,11 @@ def _lenet_ps_built(cfg) -> Built:
         mesh = make_mesh(num_workers=cfg.num_workers)
     step = make_ps_train_step(model, tx, cfg, mesh, donate=True)
     state = jax.eval_shape(
-        lambda: init_ps_state(model, tx, cfg, jax.random.key(0), (1, 28, 28, 1))
+        lambda: init_ps_state(model, tx, cfg, jax.random.key(0), (1,) + hw)
     )
     batch = {
         "image": jax.ShapeDtypeStruct(
-            (cfg.num_workers, 28, 28, 1), jnp.uint8
+            (cfg.num_workers,) + hw, jnp.uint8
         ),
         "label": jax.ShapeDtypeStruct((cfg.num_workers,), jnp.int32),
     }
@@ -147,27 +226,39 @@ def _lenet_ps_built(cfg) -> Built:
     )
 
 
-def _ps_spec(compress, placement, dcn_hosts: int = 1) -> ContractSpec:
+def _ps_spec(
+    compress,
+    placement,
+    dcn_hosts: int = 1,
+    bucket_bytes: Optional[int] = None,
+    network: str = "LeNet",
+) -> ContractSpec:
     from ..parallel.mesh import DCN_AXIS, WORKER_AXIS
 
     name = "ps_{}_{}".format(compress or "none", placement)
     if dcn_hosts > 1:
         name = "ps_hier_{}_{}".format(compress, placement)
+    if network != "LeNet":
+        name = name.replace("ps_", f"ps_{network.lower()}_", 1)
+    if bucket_bytes is not None:
+        name += "_bucketed"
     axes: Tuple[str, ...] = (
         (DCN_AXIS, WORKER_AXIS) if dcn_hosts > 1 else (WORKER_AXIS,)
     )
 
-    def build() -> Built:
+    def make_cfg():
         from ..parallel.ps import PSConfig
 
-        return _lenet_ps_built(
-            PSConfig(
-                num_workers=MESH_DEVICES,
-                compress=compress,
-                opt_placement=placement,
-                dcn_hosts=dcn_hosts,
-            )
+        return PSConfig(
+            num_workers=MESH_DEVICES,
+            compress=compress,
+            opt_placement=placement,
+            dcn_hosts=dcn_hosts,
+            bucket_bytes=bucket_bytes,
         )
+
+    def build() -> Built:
+        return _cnn_ps_built(make_cfg(), network)
 
     # the reduce that must feed the optimizer, per §6b ladder rung:
     # lossless/int8 reduce with a psum (psum_scatter when ZeRO-1 sharded);
@@ -204,6 +295,22 @@ def _ps_spec(compress, placement, dcn_hosts: int = 1) -> ContractSpec:
         wire = WirePolicy(axes=axes, payload_dtype="int8",
                           allow=tuple(allow))
 
+    fusion = None
+    if bucket_bytes is not None or placement == "sharded":
+        # bucketed configs declare their O(n_buckets) budget; the ZeRO-1
+        # sharded wire is flat by construction, so its fusion contract
+        # (ONE reduce per step) holds even in the legacy spelling. The
+        # hierarchical scheme legitimately pays two all_to_alls per
+        # bucket (ICI scatter + DCN scatter).
+        from ..parallel.ps import wire_align
+
+        fusion = FusionSpec(
+            payload_bytes=payload_bytes(network),
+            bucket_bytes=bucket_bytes or 0,
+            align=wire_align(make_cfg()),
+            per_bucket=2 if dcn_hosts > 1 else 1,
+        )
+
     return ContractSpec(
         name=name,
         build=build,
@@ -211,6 +318,7 @@ def _ps_spec(compress, placement, dcn_hosts: int = 1) -> ContractSpec:
         grad_reduce=grad_reduce,
         wire=wire,
         donation=DonationSpec(argnums=(0,), out_positions=(0,)),
+        fusion=fusion,
     )
 
 
@@ -380,15 +488,45 @@ def _dp_tp_pp_spec() -> ContractSpec:
     )
 
 
+# the flagship bucketed config's bucket size (4 MiB): ResNet18's
+# ~44.7 MB f32 gradient payload -> 11 buckets instead of 62 per-leaf
+# collectives. MiB-scale buckets amortize collective latency without
+# blowing up program size; tiny buckets on big models de-fuse again.
+RESNET_BUCKET_BYTES = 4 << 20
+
+
 def get_contracts() -> Tuple[ContractSpec, ...]:
     """The committed registry: the PS matrix (compress x placement, plus
-    the hierarchical DCN x ICI composition) and the LM schemes."""
+    the hierarchical DCN x ICI composition), the bucketed-wire variants
+    (PSC106), the ResNet per-leaf/bucketed pair whose artifact rows
+    document the collective-count collapse, and the LM schemes."""
     specs = [
         _ps_spec(c, p)
         for c in (None, "int8", "int8_2round")
         for p in ("replicated", "sharded")
     ]
     specs.append(_ps_spec("int8_2round", "replicated", dcn_hosts=2))
+    # fused-wire variants of every replicated scheme (bucket_bytes=0: ONE
+    # flat buffer; the sharded placement is already flat, its legacy
+    # specs above carry the fusion contract directly)
+    specs.extend(
+        _ps_spec(c, "replicated", bucket_bytes=0)
+        for c in (None, "int8", "int8_2round")
+    )
+    specs.append(
+        _ps_spec("int8_2round", "replicated", dcn_hosts=2, bucket_bytes=0)
+    )
+    # the headline A/B pair: the reference-shaped per-leaf wire vs the
+    # 4 MiB bucketed wire on the real ResNet18 gradient pytree — the
+    # committed artifact pins one-psum-per-leaf collapsing to
+    # ceil(payload / bucket_bytes)
+    specs.append(_ps_spec("int8", "replicated", network="ResNet18"))
+    specs.append(
+        _ps_spec(
+            "int8", "replicated", network="ResNet18",
+            bucket_bytes=RESNET_BUCKET_BYTES,
+        )
+    )
     specs.extend(
         [_dp_tp_spec(), _pp_spec(), _moe_spec(), _dp_tp_pp_spec()]
     )

@@ -19,6 +19,11 @@
 | PSC105 | dropped donation: every donated input must survive lowering as  |
 |        | a donor/alias mark, and its output partner must match in        |
 |        | structure/shape/dtype (mismatch = XLA silently un-donates)      |
+| PSC106 | silent de-fusion on a bucketed wire: a scheme declaring a       |
+|        | FusionSpec may emit at most per_bucket * ceil(payload_bytes /   |
+|        | bucket_bytes) + slack reduce-kind collectives feeding the       |
+|        | updated params — a refactor quietly going back to one           |
+|        | collective per pytree leaf fails the gate                       |
 """
 
 from __future__ import annotations
@@ -26,8 +31,9 @@ from __future__ import annotations
 from typing import Dict, List, Sequence
 
 from .core import CheckFinding, TraceResult
+from .walker import REDUCE_KINDS
 
-RULE_IDS = ("PSC101", "PSC102", "PSC103", "PSC104", "PSC105")
+RULE_IDS = ("PSC101", "PSC102", "PSC103", "PSC104", "PSC105", "PSC106")
 
 
 def psc101_axes(r: TraceResult) -> List[CheckFinding]:
@@ -106,6 +112,36 @@ def psc103_wire(r: TraceResult) -> List[CheckFinding]:
     return out
 
 
+def psc106_fusion(r: TraceResult) -> List[CheckFinding]:
+    """Count the reduce-kind collectives on the gradient path (the
+    payload-carrying psum / psum_scatter / all_to_all eqns that feed the
+    updated params — scale pmax rows, the guard pmin, gathers, and the
+    metrics pmean are out of scope) against the declared bucket budget."""
+    fu = r.spec.fusion
+    if fu is None:
+        return []
+    got = sum(
+        1
+        for c in r.collectives
+        if c.feeds_params and c.kind in REDUCE_KINDS
+    )
+    if got <= fu.max_collectives:
+        return []
+    granularity = (
+        "one fused buffer"
+        if not fu.bucket_bytes
+        else f"{fu.n_buckets} bucket(s) of ~{fu.bucket_bytes} B"
+    )
+    return [CheckFinding(
+        "PSC106", r.spec.name,
+        f"{got} gradient-path reduce collectives, but the declared "
+        f"bucket plan ({granularity} over {fu.payload_bytes} B payload, "
+        f"per_bucket={fu.per_bucket}, slack={fu.slack}) allows at most "
+        f"{fu.max_collectives} — the wire has silently de-fused "
+        f"(per-leaf collectives crept back in?)",
+    )]
+
+
 def psc105_donation(r: TraceResult) -> List[CheckFinding]:
     if r.spec.donation is None:
         return []
@@ -128,6 +164,7 @@ def check_result(r: TraceResult) -> List[CheckFinding]:
         + psc102_grad_reduce(r)
         + psc103_wire(r)
         + psc105_donation(r)
+        + psc106_fusion(r)
     )
 
 

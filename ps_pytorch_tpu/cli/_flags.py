@@ -83,6 +83,18 @@ def add_train_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     return parser
 
 
+def _bucket_bytes(val: str) -> int:
+    # -1 is the only negative with a meaning (legacy per-leaf wire); any
+    # other negative is a typo that would otherwise silently select it
+    n = int(val)
+    if n < -1:
+        raise argparse.ArgumentTypeError(
+            f"--bucket-bytes must be -1 (per-leaf), 0 (one fused buffer) "
+            f"or a positive byte budget, got {n}"
+        )
+    return n
+
+
 def add_ps_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--num-workers", type=int, default=0,
                         help="mesh size (0 = all visible devices)")
@@ -104,6 +116,13 @@ def add_ps_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "--opt-placement modes)")
     parser.add_argument("--quant-block-size", type=int, default=0,
                         help="per-block quantization scale granularity (0 = per-tensor)")
+    parser.add_argument("--bucket-bytes", type=_bucket_bytes, default=-1,
+                        help="gradient wire granularity: -1 = legacy "
+                             "message-per-leaf collectives, 0 = ONE fused "
+                             "flat buffer, N = ~N-byte contiguous buckets "
+                             "aligned to the quantization block "
+                             "(O(n_buckets) collectives instead of "
+                             "O(n_leaves); parallel/buckets.py)")
     parser.add_argument("--quant-rounding", type=str, default="nearest",
                         choices=("nearest", "stochastic"),
                         help="stochastic = unbiased gradient quantization")
@@ -184,6 +203,9 @@ def ps_config_from(args: argparse.Namespace, num_workers: int) -> PSConfig:
         }[args.compress_grad],
         quant_block_size=args.quant_block_size,
         quant_rounding=args.quant_rounding,
+        bucket_bytes=(
+            None if args.bucket_bytes < 0 else args.bucket_bytes
+        ),
         error_feedback=args.error_feedback,
         opt_placement=args.opt_placement,
         bn_mode=args.bn_mode,

@@ -150,7 +150,17 @@ def shard_for_worker(
 def prefetch_to_device(
     iterator: Iterator[dict], size: int = 2, device=None
 ) -> Iterator[dict]:
-    """Keep `size` batches ahead on device (reference's pin-memory analogue)."""
+    """Keep `size` batches ahead on device (reference's pin-memory analogue).
+
+    ``device`` is anything ``jax.device_put`` accepts: None (default
+    device — the single-device evaluator path), a concrete ``Device``,
+    or a ``jax.sharding.Sharding`` (e.g. ``NamedSharding(mesh,
+    P(axis))``) — with a sharding, prefetched batches land on the mesh
+    ALREADY split across workers, so the train step consumes them
+    directly instead of re-laying-out a replicated batch inside the
+    step. A PartitionSpec shorter than a leaf's rank shards the leading
+    (batch) dim and replicates the rest, which fits both the [B,H,W,C]
+    images and the [B] labels."""
     queue = collections.deque()
 
     def enqueue(n):

@@ -74,6 +74,7 @@ from .ulysses import (
 from .ps import (
     PSConfig,
     PSTrainState,
+    batch_sharding,
     init_ps_state,
     make_ps_eval_step,
     make_ps_train_step,

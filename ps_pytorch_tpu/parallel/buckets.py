@@ -1,0 +1,208 @@
+"""Bucketed flat-buffer comm engine: one collective per bucket instead of
+one per pytree leaf.
+
+The reference PS sends one MPI message per layer (tag 88+l) and the
+per-leaf collectives in collectives.py inherited that shape: a
+ResNet/transformer gradient pytree has dozens of leaves, so every step
+pays dozens of small, latency-bound collectives. The fused-buffer
+all-reduce family (DynamiQ / THC, PAPERS.md) gets the wire win by
+aggregating first: flatten the whole gradient into one contiguous f32
+buffer, carve it into a handful of fixed-size buckets, and ship each
+bucket as ONE collective — O(n_buckets) instead of O(n_leaves).
+
+Two layers, both pure shape bookkeeping (everything here is static
+Python arithmetic; the arrays never leave the traced program):
+
+- ``TreeLayout`` — a pytree's flat geometry: per-leaf shapes/dtypes and
+  element offsets into the concatenated f32 vector. ``tree_to_flat`` /
+  ``flat_to_tree`` round-trip every leaf bit-exactly (dtype and shape
+  preserved, empty and odd-sized leaves included). This is the engine's
+  replacement for the ad-hoc ``ravel_pytree`` in the ZeRO-1 path: same
+  concat order (``tree_leaves``), explicit f32 wire dtype.
+- ``BucketPlan`` — a partition of the (alignment-padded) flat buffer
+  into contiguous buckets. Boundaries are aligned to the int8
+  quantization block size, so no quantization block ever straddles a
+  bucket: each bucket quantizes with its own scale row(s) and ships
+  independently.
+
+PRNG discipline: stochastic-rounding keys are folded by each bucket's
+START OFFSET in the flat buffer (``BucketPlan.starts``), not by its
+enumeration index — position-stable derivation, so a bucket's noise
+stream is a function of where its bytes live, not of how many buckets
+precede it (collectives.py ``key_offsets``).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, List, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+
+
+def _align_up(n: int, align: int) -> int:
+    return -(-n // align) * align
+
+
+@dataclasses.dataclass(frozen=True)
+class TreeLayout:
+    """Static geometry of a pytree flattened into one f32 vector."""
+
+    treedef: Any
+    shapes: Tuple[Tuple[int, ...], ...]
+    dtypes: Tuple[Any, ...]
+    offsets: Tuple[int, ...]   # element offset of each leaf in the flat vec
+    total: int                 # total elements (unpadded)
+
+
+def tree_layout(tree) -> TreeLayout:
+    leaves, treedef = jax.tree_util.tree_flatten(tree)
+    shapes, dtypes, offsets = [], [], []
+    off = 0
+    for leaf in leaves:
+        shapes.append(tuple(int(d) for d in jnp.shape(leaf)))
+        dtypes.append(jnp.result_type(leaf))
+        offsets.append(off)
+        off += int(jnp.size(leaf))
+    return TreeLayout(
+        treedef=treedef,
+        shapes=tuple(shapes),
+        dtypes=tuple(dtypes),
+        offsets=tuple(offsets),
+        total=off,
+    )
+
+
+def tree_to_flat(tree) -> jax.Array:
+    """Concatenate every leaf (tree_leaves order) into one f32 vector."""
+    leaves = jax.tree_util.tree_leaves(tree)
+    if not leaves:
+        return jnp.zeros((0,), jnp.float32)
+    return jnp.concatenate(
+        [leaf.astype(jnp.float32).reshape(-1) for leaf in leaves]
+    )
+
+
+def flat_to_tree(layout: TreeLayout, flat: jax.Array):
+    """Invert ``tree_to_flat``: slice per leaf, restore shape AND dtype.
+
+    ``flat`` may be longer than ``layout.total`` (alignment padding);
+    the tail is dropped."""
+    leaves = []
+    for shape, dtype, off in zip(layout.shapes, layout.dtypes,
+                                 layout.offsets):
+        n = 1
+        for d in shape:
+            n *= d
+        leaves.append(
+            jax.lax.slice(flat, (off,), (off + n,))
+            .reshape(shape)
+            .astype(dtype)
+        )
+    return jax.tree_util.tree_unflatten(layout.treedef, leaves)
+
+
+@dataclasses.dataclass(frozen=True)
+class BucketPlan:
+    """A partition of the alignment-padded flat buffer into buckets."""
+
+    total: int          # unpadded elements
+    padded_total: int   # total rounded up to `align`
+    align: int          # element alignment (int8 quantization block size)
+    starts: Tuple[int, ...]  # bucket start offsets (== the PRNG fold keys)
+    sizes: Tuple[int, ...]   # bucket lengths — EVERY one a multiple of
+                             # `align` (padded_total is too, so the last
+                             # bucket is as aligned as the rest; the
+                             # sharded scatter's size // n splits rely
+                             # on this)
+
+    @property
+    def n_buckets(self) -> int:
+        return len(self.starts)
+
+
+def plan_buckets(total: int, bucket_bytes: int, align: int = 1) -> BucketPlan:
+    """Carve ``total`` f32 elements into buckets of ~``bucket_bytes``.
+
+    ``bucket_bytes == 0`` means one fused bucket covering everything.
+    Bucket boundaries are multiples of ``align`` (the int8 quantization
+    block size), so per-block scale rows never straddle buckets; the
+    bucket element count is ``bucket_bytes // 4`` rounded DOWN to the
+    alignment (floored at one block) — a bucket never exceeds the
+    requested byte budget by more than one block's padding."""
+    if bucket_bytes < 0:
+        raise ValueError(f"bucket_bytes must be >= 0, got {bucket_bytes}")
+    align = max(int(align), 1)
+    padded_total = max(_align_up(total, align), align)
+    if bucket_bytes == 0:
+        bucket_elems = padded_total
+    else:
+        bucket_elems = max((bucket_bytes // 4) // align * align, align)
+    starts, sizes = [], []
+    off = 0
+    while off < padded_total:
+        size = min(bucket_elems, padded_total - off)
+        starts.append(off)
+        sizes.append(size)
+        off += size
+    return BucketPlan(
+        total=total,
+        padded_total=padded_total,
+        align=align,
+        starts=tuple(starts),
+        sizes=tuple(sizes),
+    )
+
+
+def split_buckets(flat_padded: jax.Array, plan: BucketPlan) -> List[jax.Array]:
+    """Static slices of the padded flat buffer, one per bucket."""
+    return [
+        jax.lax.slice(flat_padded, (s,), (s + n,))
+        for s, n in zip(plan.starts, plan.sizes)
+    ]
+
+
+def concat_buckets(buckets: Sequence[jax.Array]) -> jax.Array:
+    return jnp.concatenate(list(buckets))
+
+
+def pad_flat(flat: jax.Array, plan: BucketPlan) -> jax.Array:
+    return jnp.pad(flat, (0, plan.padded_total - plan.total))
+
+
+def piece_stream(tree, bucket_bytes, align: int = 1):
+    """The comm engine's one entry point: what a collective scheme ships.
+
+    Returns ``(pieces, key_ids, rebuild)``:
+
+    - ``pieces``: the arrays to quantize/reduce — the pytree's leaves
+      verbatim when ``bucket_bytes is None`` (legacy per-leaf wire), or
+      the contiguous f32 buckets of the flattened tree otherwise
+      (``0`` = one fused bucket, ``N`` = ~N-byte buckets aligned to
+      ``align`` elements);
+    - ``key_ids``: the position-stable PRNG fold value for each piece —
+      the enumeration index per leaf (the legacy discipline error-
+      feedback residuals already mirror), the bucket's START OFFSET in
+      the flat buffer per bucket (so a piece's stochastic-rounding
+      stream depends on where its bytes live, not on how many pieces
+      precede it);
+    - ``rebuild``: maps the per-piece aggregation results (same shapes
+      as ``pieces``) back to the original tree structure, restoring
+      every leaf's dtype/shape and dropping alignment padding.
+    """
+    if bucket_bytes is None:
+        leaves, treedef = jax.tree_util.tree_flatten(tree)
+        return (
+            leaves,
+            tuple(range(len(leaves))),
+            lambda outs: jax.tree_util.tree_unflatten(treedef, outs),
+        )
+    layout = tree_layout(tree)
+    plan = plan_buckets(layout.total, bucket_bytes, align=align)
+    pieces = split_buckets(pad_flat(tree_to_flat(tree), plan), plan)
+    return (
+        pieces,
+        plan.starts,
+        lambda outs: flat_to_tree(layout, concat_buckets(outs)),
+    )

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from ..ops.quantize import dequantize_int8, quantize_int8
+from .buckets import piece_stream
 
 
 def aggregation_mask(
@@ -54,11 +55,21 @@ def aggregation_mask(
     raise ValueError(f"unknown aggregation mode {mode!r}")
 
 
-def psum_mean(tree, axis_name: str, denominator: float):
+def psum_mean(tree, axis_name: str, denominator: float,
+              bucket_bytes: Optional[int] = None):
     """Sum over workers / denominator (parity: _model_update divides the
-    aggregate buffer by num_aggregate, sync_replicas_master_nn.py:204-207)."""
-    summed = lax.psum(tree, axis_name)
-    return jax.tree_util.tree_map(lambda g: g / denominator, summed)
+    aggregate buffer by num_aggregate, sync_replicas_master_nn.py:204-207).
+
+    ``bucket_bytes`` (buckets.piece_stream) ships the fused flat f32
+    buckets instead of the raw leaves — bit-exact for f32 gradients
+    (same values, same elementwise sum/divide), and the collective
+    operands become a few contiguous buffers instead of one per leaf."""
+    if bucket_bytes is None:
+        summed = lax.psum(tree, axis_name)
+        return jax.tree_util.tree_map(lambda g: g / denominator, summed)
+    pieces, _, rebuild = piece_stream(tree, bucket_bytes)
+    summed = lax.psum(pieces, axis_name)  # one fused eqn over the buckets
+    return rebuild([s / denominator for s in summed])
 
 
 def quantized_psum(
@@ -68,17 +79,24 @@ def quantized_psum(
     block_size: int = 0,
     rounding: str = "nearest",
     key: Optional[jax.Array] = None,
+    bucket_bytes: Optional[int] = None,
 ):
     """int8-quantized gradient all-reduce.
 
-    Per leaf: global absmax (pmax) -> symmetric int8 quantize -> int32 psum
+    Per piece: global absmax (pmax) -> symmetric int8 quantize -> int32 psum
     -> dequantize / denominator. Deterministic (same scale on all workers) and
     exact-sum in int32 (no overflow below 2^23 workers). `block_size` > 0
     switches to per-block scales for tighter quantization error; `rounding=
     "stochastic"` makes each worker's quantization unbiased with independent
-    noise (key folded by worker index and leaf), so rounding error averages
-    out across the psum instead of accumulating (capabilities beyond the
-    reference's lossless-but-slow Blosc path).
+    noise (key folded by worker index and piece id), so rounding error
+    averages out across the psum instead of accumulating (capabilities beyond
+    the reference's lossless-but-slow Blosc path).
+
+    A piece is one pytree leaf (``bucket_bytes=None``, the reference's
+    message-per-layer shape) or one fused flat bucket (buckets.py) — the
+    latter collapses O(n_leaves) pmax+psum pairs into O(n_buckets), with
+    bucket boundaries aligned to ``block_size`` so no scale row straddles
+    buckets and PRNG keys folded by bucket start offset (position-stable).
     """
     if rounding == "stochastic":
         if key is None:
@@ -99,10 +117,10 @@ def quantized_psum(
         deq = dequantize_int8(s, scale, block_size=block_size, shape=g.shape)
         return deq / denominator
 
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
-    return jax.tree_util.tree_unflatten(
-        treedef, [one(i, g) for i, g in enumerate(leaves)]
+    pieces, key_ids, rebuild = piece_stream(
+        tree, bucket_bytes, align=block_size or 1
     )
+    return rebuild([one(i, g) for i, g in zip(key_ids, pieces)])
 
 
 def _slice_len(total: int, n: int, block_size: int) -> int:
@@ -172,6 +190,7 @@ def quantized_allreduce_2round(
     block_size: int = 0,
     rounding: str = "nearest",
     key: Optional[jax.Array] = None,
+    bucket_bytes: Optional[int] = None,
 ):
     """Two-round int8 all-reduce whose WIRE traffic is actually int8.
 
@@ -220,10 +239,10 @@ def quantized_allreduce_2round(
         )
         return (deq[:total] / denominator).reshape(g.shape)
 
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
-    return jax.tree_util.tree_unflatten(
-        treedef, [one(i, g) for i, g in enumerate(leaves)]
+    pieces, key_ids, rebuild = piece_stream(
+        tree, bucket_bytes, align=block_size or 1
     )
+    return rebuild([one(i, g) for i, g in zip(key_ids, pieces)])
 
 
 def quantized_allreduce_2round_hier(
@@ -234,6 +253,7 @@ def quantized_allreduce_2round_hier(
     block_size: int = 0,
     rounding: str = "nearest",
     key: Optional[jax.Array] = None,
+    bucket_bytes: Optional[int] = None,
 ):
     """Hierarchical (DCN x ICI) bandwidth-honest int8 all-reduce that
     crosses DCN exactly ONCE per gradient element.
@@ -295,10 +315,10 @@ def quantized_allreduce_2round_hier(
         full = lax.all_gather(region, ici_axis, tiled=True)
         return (full[:total] / denominator).reshape(g.shape)
 
-    leaves, treedef = jax.tree_util.tree_flatten(tree)
-    return jax.tree_util.tree_unflatten(
-        treedef, [one(i, g) for i, g in enumerate(leaves)]
+    pieces, key_ids, rebuild = piece_stream(
+        tree, bucket_bytes, align=block_size or 1
     )
+    return rebuild([one(i, g) for i, g in zip(key_ids, pieces)])
 
 
 def local_quantized_contribution(
@@ -307,12 +327,14 @@ def local_quantized_contribution(
     block_size: int = 0,
     rounding: str = "nearest",
     key: Optional[jax.Array] = None,
+    bucket_bytes: Optional[int] = None,
 ):
     """What THIS worker's gradient becomes after its (shared-scale) int8
     round trip — the transmitted value whose difference from the true
     gradient is the error-feedback residual. Mirrors quantized_psum /
     round 1 of the 2-round scheme exactly (same scales, same rounding
-    keys), so `residual = g - contribution` is the real on-wire error."""
+    keys, same bucketing and key-fold discipline), so `residual = g -
+    contribution` is the real on-wire error."""
     if rounding == "stochastic":
         if key is None:
             raise ValueError("stochastic rounding needs a key")
@@ -332,10 +354,10 @@ def local_quantized_contribution(
             q.astype(jnp.int32), scale, block_size=block_size, shape=g.shape
         )
 
-    leaves, treedef = jax.tree_util.tree_flatten(grads)
-    return jax.tree_util.tree_unflatten(
-        treedef, [one(i, g) for i, g in enumerate(leaves)]
+    pieces, key_ids, rebuild = piece_stream(
+        grads, bucket_bytes, align=block_size or 1
     )
+    return rebuild([one(i, g) for i, g in zip(key_ids, pieces)])
 
 
 def aggregate_gradients(
@@ -351,8 +373,15 @@ def aggregate_gradients(
     quant_key: Optional[jax.Array] = None,
     return_contribution: bool = False,
     axis_sizes: Optional[tuple] = None,
+    bucket_bytes: Optional[int] = None,
 ):
-    """The full PS aggregation: mask -> (quantized) reduce -> / K.
+    """The full PS aggregation: mask -> (bucket) -> (quantized) reduce -> / K.
+
+    ``bucket_bytes`` selects the wire granularity (PSConfig.bucket_bytes):
+    ``None`` = the legacy message-per-leaf shape, ``0`` = one fused flat
+    buffer, ``N`` = ~N-byte buckets. Every scheme and the EF contribution
+    share the same piece stream (buckets.piece_stream), so residuals
+    mirror the transmitted values exactly in either granularity.
 
     return_contribution=True additionally returns THIS worker's
     transmitted (post-mask, post-quantization-round-trip) value — what
@@ -383,7 +412,7 @@ def aggregate_gradients(
         sel = aggregation_mask(axis_name, num_workers, num_aggregate, mask_key, mask_mode)
         grads = jax.tree_util.tree_map(lambda g: g * sel.astype(g.dtype), grads)
     if compress in (None, "none"):
-        agg = psum_mean(grads, axis_name, float(k))
+        agg = psum_mean(grads, axis_name, float(k), bucket_bytes=bucket_bytes)
         contribution = grads  # lossless transmit: residual is zero
     elif compress == "int8":
         agg = quantized_psum(
@@ -393,6 +422,7 @@ def aggregate_gradients(
             block_size=quant_block_size,
             rounding=quant_rounding,
             key=quant_key,
+            bucket_bytes=bucket_bytes,
         )
         contribution = None
     elif hier_2round:
@@ -409,6 +439,7 @@ def aggregate_gradients(
             block_size=quant_block_size,
             rounding=quant_rounding,
             key=quant_key,
+            bucket_bytes=bucket_bytes,
         )
         contribution = None
     elif compress == "int8_2round":
@@ -420,6 +451,7 @@ def aggregate_gradients(
             block_size=quant_block_size,
             rounding=quant_rounding,
             key=quant_key,
+            bucket_bytes=bucket_bytes,
         )
         contribution = None
     else:
@@ -443,5 +475,6 @@ def aggregate_gradients(
             block_size=quant_block_size,
             rounding=quant_rounding,
             key=contrib_key,
+            bucket_bytes=bucket_bytes,
         )
     return agg, contribution

@@ -42,7 +42,6 @@ import jax
 import jax.numpy as jnp
 import optax
 from jax import lax
-from jax.flatten_util import ravel_pytree
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import apply_model
@@ -52,6 +51,15 @@ from ..resilience.guard import (
     init_guard_state,
     tree_all_finite,
     update_guard_state,
+)
+from .buckets import (
+    BucketPlan,
+    concat_buckets,
+    flat_to_tree,
+    pad_flat,
+    plan_buckets,
+    tree_layout,
+    tree_to_flat,
 )
 from .collectives import aggregate_gradients, aggregation_mask
 from .mesh import WORKER_AXIS
@@ -82,6 +90,16 @@ class PSConfig:
     compress: Optional[str] = None
     quant_block_size: int = 0
     quant_rounding: str = "nearest"  # "nearest" | "stochastic" (unbiased)
+    # gradient wire granularity (parallel/buckets.py): None = legacy
+    # message-per-leaf collectives (the reference's tag-88+l shape), 0 =
+    # ONE fused flat f32 buffer, N = ~N-byte contiguous buckets with
+    # boundaries aligned to the int8 quantization block — O(n_buckets)
+    # collectives per step instead of O(n_leaves). The ZeRO-1 sharded
+    # placement's wire is flat by construction; there None and 0 are the
+    # same fused buffer and N>0 carves the scatter into buckets. With
+    # bucketing on, the non-finite guard reduces ONE fused isfinite over
+    # the flat buffer instead of one per leaf.
+    bucket_bytes: Optional[int] = None
     # error feedback (EF-SGD): each worker keeps the residual its
     # compression dropped and adds it back next step, so quantization
     # error accumulates into the update instead of being lost — the
@@ -142,6 +160,11 @@ class PSConfig:
             raise ValueError(f"bad compress {self.compress!r}")
         if self.quant_rounding not in ("nearest", "stochastic"):
             raise ValueError(f"bad quant_rounding {self.quant_rounding!r}")
+        if self.bucket_bytes is not None and self.bucket_bytes < 0:
+            raise ValueError(
+                f"bad bucket_bytes {self.bucket_bytes} (None = per-leaf, "
+                f"0 = one fused buffer, N>0 = ~N-byte buckets)"
+            )
         if self.error_feedback and self.compress in (None, "none"):
             raise ValueError("error_feedback needs a compress mode")
         if self.dynamic_loss_scale:
@@ -212,16 +235,40 @@ def _flat_padded_size(params) -> int:
     return sum(int(jnp.size(p)) for p in jax.tree_util.tree_leaves(params))
 
 
+def wire_align(cfg: PSConfig) -> int:
+    """Bucket-boundary alignment (f32 elements) this config's wire uses:
+    the int8 quantization block for the quantized schemes (1 for
+    per-tensor scales / no compression), × num_workers on the ZeRO-1
+    scatter so each worker's slice of each bucket owns whole scale rows.
+    The PSC106 FusionSpec derives its budget from this same function —
+    keep them one expression."""
+    block = (
+        cfg.quant_block_size
+        if cfg.compress in ("int8", "int8_2round") and cfg.quant_block_size
+        else 1
+    )
+    return (
+        cfg.num_workers * block if cfg.opt_placement == "sharded" else block
+    )
+
+
+def _sharded_plan(cfg: PSConfig, total: int) -> BucketPlan:
+    """Bucket geometry for the ZeRO-1 flat wire (buckets.plan_buckets).
+
+    Every bucket — and the padded total — is a multiple of
+    ``num_workers * quant_block`` (wire_align), so each worker's
+    scattered slice of each bucket owns whole quantization-scale rows.
+    The sharded wire has always been one flat buffer, so ``bucket_bytes``
+    None and 0 are the same fused plan; N>0 carves the scatter into
+    ~N-byte buckets. Must be identical at init (optimizer-state buffers,
+    EF residual rows) and in the update step."""
+    return plan_buckets(total, cfg.bucket_bytes or 0, align=wire_align(cfg))
+
+
 def _zero1_shard_size(total: int, cfg: PSConfig) -> int:
-    """Per-worker flat shard length for the ZeRO-1 placement. Must be
-    identical at init (optimizer-state buffers) and in the update step;
-    with block-quantized int8 collectives the shard is rounded up so each
-    scattered slice owns whole quantization-scale rows."""
-    shard = -(-total // cfg.num_workers)
-    if cfg.compress in ("int8", "int8_2round") and cfg.quant_block_size:
-        b = cfg.quant_block_size
-        shard = -(-shard // b) * b
-    return shard
+    """Per-worker flat shard length for the ZeRO-1 placement: this
+    worker's 1/N of every bucket of the padded flat gradient."""
+    return _sharded_plan(cfg, total).padded_total // cfg.num_workers
 
 
 def init_ps_state(
@@ -316,16 +363,39 @@ def shard_state(state: PSTrainState, mesh: Mesh, cfg: PSConfig) -> PSTrainState:
     )
 
 
+def batch_sharding(mesh: Mesh, cfg: PSConfig) -> NamedSharding:
+    """The per-worker batch sharding (leading dim split over the data
+    axis) — pass to ``data.prefetch_to_device`` so prefetched batches
+    land on the mesh already split instead of being re-laid-out inside
+    the step."""
+    return NamedSharding(mesh, P(cfg.axis_name))
+
+
 def shard_batch(batch, mesh: Mesh, cfg: PSConfig):
     """Split the global batch across workers (leading dim)."""
-    return jax.device_put(batch, NamedSharding(mesh, P(cfg.axis_name)))
+    return jax.device_put(batch, batch_sharding(mesh, cfg))
+
+
+def _worker_region(flat, plan: BucketPlan, w, n: int):
+    """Worker ``w``'s region of a bucketed flat buffer: its 1/n slice of
+    every bucket, concatenated in bucket order (one slice for the fused
+    single-bucket plan)."""
+    parts = []
+    for start, size in zip(plan.starts, plan.sizes):
+        s = size // n
+        parts.append(lax.dynamic_slice(flat, (start + w * s,), (s,)))
+    return concat_buckets(parts) if len(parts) > 1 else parts[0]
 
 
 def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key,
                        quant_key=None, err=None):
     """ZeRO-1 "sharded PS": (EF add-back) -> mask -> (quantize) ->
-    reduce_scatter -> per-shard optax update -> all_gather the parameter
-    delta. Two compressed wires:
+    reduce_scatter per bucket -> per-shard optax update -> all_gather the
+    parameter delta. The flat geometry comes from the buckets engine
+    (buckets.tree_layout / tree_to_flat — the same concat order and
+    round-trip the replicated wire uses), carved by ``_sharded_plan``:
+    one fused bucket for bucket_bytes None/0, ~N-byte buckets otherwise.
+    Two compressed wires:
 
     - "int8": quantize, int32 psum_scatter — the sum is EXACT in int32
       but the interconnect carries int32 (compute-side compression).
@@ -336,14 +406,19 @@ def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key,
       re-broadcast (parameters return via the f32 all_gather of updates,
       the analogue of the reference master's weight bcast).
 
+    Per-bucket quantization keys fold the bucket's START OFFSET in the
+    flat buffer (position-stable — the same discipline as
+    collectives.piece_stream), so the noise stream a byte sees depends on
+    where it lives, not on how many buckets precede it.
+
     `err` (error feedback) is this worker's residual on the FLAT padded
     gradient vector; returns (new_params, new_opt, new_err)."""
     axis, n = cfg.axis_name, cfg.num_workers
     k = cfg.effective_aggregate
-    flat_g, unravel = ravel_pytree(grads)
-    total = flat_g.shape[0]
-    shard = _zero1_shard_size(total, cfg)
-    flat_g = jnp.pad(flat_g.astype(jnp.float32), (0, shard * n - total))
+    layout = tree_layout(grads)
+    total = layout.total
+    plan = _sharded_plan(cfg, total)
+    flat_g = pad_flat(tree_to_flat(grads), plan)
     if err is not None:
         flat_g = flat_g + err
     if k != n:
@@ -353,53 +428,81 @@ def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key,
         sent = flat_g
     new_err = None
     bsz = cfg.quant_block_size
+    w = lax.axis_index(axis)
     if cfg.compress in ("int8", "int8_2round"):
         if cfg.quant_rounding == "stochastic" and quant_key is not None:
-            quant_key = jax.random.fold_in(quant_key, lax.axis_index(axis))
-        q, scale = quantize_int8(
-            sent,
-            axis_name=axis,
-            block_size=bsz,
-            rounding=cfg.quant_rounding,
-            key=quant_key,
-        )
+            quant_key = jax.random.fold_in(quant_key, w)
+        g_shards, contribs = [], []
+        for start, size in zip(plan.starts, plan.sizes):
+            bucket = lax.slice(sent, (start,), (start + size,))
+            s = size // n
+            bkey = (
+                jax.random.fold_in(quant_key, start)
+                if quant_key is not None
+                else None
+            )
+            q, scale = quantize_int8(
+                bucket,
+                axis_name=axis,
+                block_size=bsz,
+                rounding=cfg.quant_rounding,
+                key=bkey,
+            )
+            if err is not None:
+                # what the wire carries after the int8 round trip — the
+                # residual is everything it dropped (incl. the whole
+                # gradient on mask-excluded steps: sent==0 -> q==0 ->
+                # contribution 0)
+                contribs.append(dequantize_int8(
+                    q.astype(jnp.int32), scale, block_size=bsz,
+                    shape=(size,),
+                ))
+            if cfg.compress == "int8":
+                sb = lax.psum_scatter(
+                    q.reshape(-1).astype(jnp.int32), axis, tiled=True
+                )
+            else:
+                q8 = q.reshape(n, s).astype(jnp.int8)
+                recv = lax.all_to_all(
+                    q8, axis, split_axis=0, concat_axis=0, tiled=True
+                )
+                sb = jnp.sum(recv.astype(jnp.int32), axis=0)  # [s]
+            if bsz:
+                nb_loc = s // bsz
+                my_scales = lax.dynamic_slice(
+                    scale, (w * nb_loc, 0), (nb_loc, 1)
+                )
+                g_shards.append((
+                    sb.reshape(nb_loc, bsz).astype(jnp.float32) * my_scales
+                ).reshape(-1) / k)
+            else:
+                g_shards.append(dequantize_int8(sb, scale) / k)
+        g_shard = concat_buckets(g_shards)
         if err is not None:
-            # what the wire carries after the int8 round trip — the
-            # residual is everything it dropped (incl. the whole gradient
-            # on mask-excluded steps: sent==0 -> q==0 -> contribution 0)
-            contribution = dequantize_int8(
-                q.astype(jnp.int32), scale, block_size=bsz,
-                shape=(shard * n,),
-            )
-            new_err = flat_g - contribution
-        w = lax.axis_index(axis)
-        if cfg.compress == "int8":
-            s = lax.psum_scatter(
-                q.reshape(-1).astype(jnp.int32), axis, tiled=True
-            )
-        else:
-            q8 = q.reshape(n, shard).astype(jnp.int8)
-            recv = lax.all_to_all(
-                q8, axis, split_axis=0, concat_axis=0, tiled=True
-            )
-            s = jnp.sum(recv.astype(jnp.int32), axis=0)  # [shard]
-        if bsz:
-            nb_shard = shard // bsz
-            scale_shard = lax.dynamic_slice(scale, (w * nb_shard, 0), (nb_shard, 1))
-            g_shard = (
-                s.reshape(nb_shard, bsz).astype(jnp.float32) * scale_shard
-            ).reshape(-1) / k
-        else:
-            g_shard = dequantize_int8(s, scale) / k
+            new_err = flat_g - concat_buckets(contribs)
     else:
-        g_shard = lax.psum_scatter(sent, axis, tiled=True) / k
-    flat_p, _ = ravel_pytree(params)
-    flat_p = jnp.pad(flat_p.astype(jnp.float32), (0, shard * n - total))
-    w = lax.axis_index(axis)
-    p_shard = lax.dynamic_slice(flat_p, (w * shard,), (shard,))
+        g_shard = concat_buckets([
+            lax.psum_scatter(
+                lax.slice(sent, (start,), (start + size,)), axis, tiled=True
+            )
+            for start, size in zip(plan.starts, plan.sizes)
+        ]) / k
+    flat_p = pad_flat(tree_to_flat(params), plan)
+    p_shard = _worker_region(flat_p, plan, w, n)
     upd_shard, new_opt = tx.update(g_shard, opt_state, p_shard)
-    upd_full = lax.all_gather(upd_shard, axis, tiled=True)[:total]
-    new_params = optax.apply_updates(params, unravel(upd_full))
+    # reassemble: each bucket's shard segment gathers back tiled, in
+    # bucket order, inverting _worker_region's layout exactly
+    off, full = 0, []
+    for size in plan.sizes:
+        s = size // n
+        full.append(lax.all_gather(
+            lax.slice(upd_shard, (off,), (off + s,)), axis, tiled=True
+        ))
+        off += s
+    upd_full = concat_buckets(full)[:total]
+    new_params = optax.apply_updates(
+        params, flat_to_tree(layout, upd_full)
+    )
     return new_params, new_opt, new_err
 
 
@@ -533,9 +636,17 @@ def make_ps_train_step(
         if cfg.nonfinite_guard:
             # mesh-wide agreement on "every worker's gradients are
             # finite": one int32 pmin — 4 bytes on the interconnect, no
-            # host transfer, and every worker takes the same branch
+            # host transfer, and every worker takes the same branch.
+            # With bucketing on, the per-worker half reduces ONE fused
+            # isfinite over the flat buffer (XLA CSEs the concat with
+            # the wire's own flatten) instead of one reduction per leaf.
+            probe = (
+                tree_to_flat(grads)
+                if cfg.bucket_bytes is not None
+                else grads
+            )
             finite = lax.pmin(
-                tree_all_finite(grads).astype(jnp.int32), axis
+                tree_all_finite(probe).astype(jnp.int32), axis
             ) > 0
 
         new_comm = comm_state
@@ -573,6 +684,7 @@ def make_ps_train_step(
                 quant_key=quant_key,
                 return_contribution=cfg.error_feedback,
                 axis_sizes=hier_sizes,
+                bucket_bytes=cfg.bucket_bytes,
             )
             if cfg.error_feedback:
                 agg, contribution = out

@@ -32,6 +32,7 @@ from .data import (
     BatchIterator,
     Dataset,
     make_preprocessor,
+    prefetch_to_device,
     prepare_data,
     shard_for_worker,
 )
@@ -39,6 +40,7 @@ from .models import build_model, input_shape_for, param_count
 from .optim import build_optimizer
 from .parallel import (
     PSConfig,
+    batch_sharding,
     init_ps_state,
     make_mesh,
     make_ps_eval_step,
@@ -520,6 +522,27 @@ class Trainer:
                 if done:
                     break
                 epochs_iters = [it.epoch() for it in iters]
+
+                def _host_batches(eis=epochs_iters):
+                    for _ in range(steps_per_epoch):
+                        parts = [next(ei) for ei in eis]
+                        yield {
+                            k: np.concatenate([p[k] for p in parts])
+                            for k in parts[0]
+                        }
+
+                # batches land on the mesh PRE-SHARDED (leading dim split
+                # across workers), so the step consumes them directly
+                # instead of re-laying-out a replicated batch. The
+                # prefetch queue dispatches each device_put one batch
+                # early — the TRANSFER overlaps compute, but the host
+                # gather itself is synchronous and stays in the fetch
+                # phase (prefetch_to_device is a plain generator, no
+                # worker thread)
+                prefetched = prefetch_to_device(
+                    _host_batches(), size=2,
+                    device=batch_sharding(self.mesh, self.pcfg),
+                )
                 for batch_idx in range(steps_per_epoch):
                     if step_no >= t.max_steps:
                         # check BEFORE stepping so a --resume of a finished run
@@ -535,11 +558,7 @@ class Trainer:
                         profiling = False
                     timer.reset()
                     with timer.phase("fetch"):
-                        parts = [next(ei) for ei in epochs_iters]
-                        batch = {
-                            k: np.concatenate([p[k] for p in parts]) for k in parts[0]
-                        }
-                        sharded = shard_batch(batch, self.mesh, self.pcfg)
+                        sharded = next(prefetched)
                     with timer.phase("step"):
                         self.state, metrics = self._train_step(
                             self.state, sharded, self._key

@@ -16,6 +16,9 @@ shaped so every rule's failure mode exists somewhere runnable:
 - undonated:      the factory forgets donate_argnums
 - donate_mismatch: donates, but returns params in another dtype, so XLA
                   can never alias the buffers (silent un-donation)
+- defused:        declares a fused (single-bucket) wire but emits one
+                  psum per "leaf" — the de-fusion regression PSC106
+                  exists for
 - ok_psum:        fully clean (the negative control)
 """
 
@@ -33,6 +36,7 @@ from ps_pytorch_tpu.check import (
     Built,
     ContractSpec,
     DonationSpec,
+    FusionSpec,
     GradReduce,
     WireAllowance,
     WirePolicy,
@@ -213,6 +217,37 @@ def _donate_mismatch() -> ContractSpec:
     )
 
 
+def _defused() -> ContractSpec:
+    L = 32
+
+    def build() -> Built:
+        mesh = _mesh_1d()
+
+        def f(p, x):
+            loss = jnp.sum(p[:4] * x[0])
+            g = jax.grad(lambda q: jnp.sum(q[:4] * x[0]))(p)
+            # BUG: the contract declares ONE fused bucket, but the
+            # reduction runs per 8-element "leaf" — four separate psum
+            # eqns on the gradient path (silent de-fusion)
+            parts = [
+                lax.psum(g[i * 8:(i + 1) * 8], AXIS) for i in range(4)
+            ]
+            g = jnp.concatenate(parts)
+            return p - 0.1 * g, lax.pmean(loss, AXIS)
+
+        step = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P(AXIS)),
+            out_specs=(P(), P()), check_vma=False,
+        ))
+        return _built(step, L)
+
+    return ContractSpec(
+        name="defused", build=build, axes=(AXIS,),
+        grad_reduce=(GradReduce(AXIS, ("psum",)),),
+        fusion=FusionSpec(payload_bytes=L * 4, bucket_bytes=0),
+    )
+
+
 def _ok_psum() -> ContractSpec:
     return ContractSpec(
         name="ok_psum",
@@ -231,5 +266,6 @@ def get_contracts():
         _drift(),
         _undonated(),
         _donate_mismatch(),
+        _defused(),
         _ok_psum(),
     )

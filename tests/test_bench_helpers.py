@@ -95,6 +95,45 @@ def test_validate_env_rejects_bad_knobs(bench, monkeypatch):
     bench._validate_env()  # no raise
 
 
+def test_bucket_knobs_tag_metric_and_validate(bench, monkeypatch):
+    """BENCH_BUCKET_BYTES / BENCH_AB_BUCKETING: tagged metric keys (never
+    shadow canonical records), CNN-only, value-validated."""
+    monkeypatch.setenv("BENCH_WORKLOAD", "lenet")
+    base = bench._success_metric()
+    monkeypatch.setenv("BENCH_BUCKET_BYTES", "0")
+    bench._validate_env()
+    assert bench._success_metric() == base + "_bkt0"
+    monkeypatch.setenv("BENCH_AB_BUCKETING", "1")
+    bench._validate_env()
+    assert bench._success_metric() == base + "_ab_bucketing"
+    monkeypatch.setenv("BENCH_BUCKET_BYTES", "-4")
+    with pytest.raises(SystemExit):
+        bench._validate_env()
+    monkeypatch.setenv("BENCH_BUCKET_BYTES", "0")
+    monkeypatch.setenv("BENCH_WORKLOAD", "lm")
+    with pytest.raises(SystemExit):
+        bench._validate_env()
+
+
+def test_comm_contract_entry_exact_match_only(bench):
+    """The committed pscheck rows attach only when the bench config maps
+    onto a traced registry entry — a different bucket carving must yield
+    None rather than mislabeled wire numbers."""
+    row = bench._comm_contract_entry("lenet", None, None)
+    assert row and row["config"] == "ps_none_replicated"
+    assert row["n_collectives"] > 0 and row["wire_bytes"] > 0
+    fused = bench._comm_contract_entry("lenet", "int8", 0)
+    assert fused and fused["config"] == "ps_int8_replicated_bucketed"
+    # the registry traces the LeNet bucketed variants at bucket_bytes=0
+    # and ResNet18 at 4 MiB — anything else must not attach
+    assert bench._comm_contract_entry("lenet", "int8", 4096) is None
+    res = bench._comm_contract_entry("resnet18", "int8", 4 << 20)
+    assert res and res["config"] == "ps_resnet18_int8_replicated_bucketed"
+    assert bench._comm_contract_entry("resnet18", "int8", 0) is None
+    # untraced combination: resnet has no compress=None registry entry
+    assert bench._comm_contract_entry("resnet18", None, None) is None
+
+
 def test_last_tpu_record_matches_metric_exactly(bench, tmp_path, monkeypatch):
     # point the repo-relative runs/ glob at a temp tree via __file__ patching
     (tmp_path / "runs" / "tpu_r99").mkdir(parents=True)

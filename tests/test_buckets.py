@@ -1,0 +1,401 @@
+"""Bucketed flat-buffer comm engine (parallel/buckets.py) parity suite.
+
+What the fused wire must preserve, pinned:
+- flatten/unflatten round-trips EVERY leaf bit-exactly — dtype and shape
+  included, empty and odd-sized leaves included;
+- bucket geometry: boundaries are multiples of the quantization block,
+  ``bucket_bytes=0`` is one fused bucket, a bucket never exceeds the
+  requested byte budget by more than one block's padding;
+- ``compress=None`` bucketed aggregation is BIT-EXACT vs the legacy
+  per-leaf psum (the engine moves bytes, it must not touch values);
+- ``int8`` bucketed stays inside its own quantization-error spec and
+  trains CIFAR-tiny to the same loss envelope as per-leaf int8;
+- PRNG keys are position-stable: a bucket's stochastic-rounding stream
+  is keyed by its START OFFSET in the flat buffer, not its enumeration
+  index — two pytrees with identical flattened content draw identical
+  noise no matter how their leaves are carved;
+- the ZeRO-1 sharded placement (now on the same engine instead of
+  ad-hoc ravel_pytree) is unchanged: ``bucket_bytes`` None and 0 are
+  the same fused wire, bit-for-bit.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ps_pytorch_tpu.models import build_model
+from ps_pytorch_tpu.optim import sgd
+from ps_pytorch_tpu.parallel import (
+    WORKER_AXIS,
+    PSConfig,
+    aggregate_gradients,
+    init_ps_state,
+    make_ps_train_step,
+    shard_batch,
+    shard_state,
+)
+from ps_pytorch_tpu.parallel.buckets import (
+    flat_to_tree,
+    pad_flat,
+    piece_stream,
+    plan_buckets,
+    tree_layout,
+    tree_to_flat,
+)
+
+N = 8
+
+tree_leaves = jax.tree_util.tree_leaves
+
+
+# ------------------------------------------------------------- pure geometry
+
+def _awkward_tree():
+    """Every flattening hazard at once: empty leaf, odd sizes, scalars,
+    mixed dtypes, nested structure."""
+    k = jax.random.key(0)
+    return {
+        "empty": jnp.zeros((0, 3), jnp.float32),
+        "odd": jax.random.normal(jax.random.fold_in(k, 1), (7, 13)),
+        "scalar": jnp.float32(3.5),
+        "bf16": jax.random.normal(
+            jax.random.fold_in(k, 2), (5,)
+        ).astype(jnp.bfloat16),
+        "ints": jnp.arange(11, dtype=jnp.int32),
+        "nest": {"a": jnp.ones((2, 2, 2)), "b": jnp.zeros((1,))},
+    }
+
+
+def test_flatten_roundtrip_preserves_dtype_shape():
+    tree = _awkward_tree()
+    layout = tree_layout(tree)
+    flat = tree_to_flat(tree)
+    assert flat.dtype == jnp.float32
+    assert flat.shape == (layout.total,)
+    back = flat_to_tree(layout, flat)
+    assert jax.tree_util.tree_structure(back) == jax.tree_util.tree_structure(tree)
+    for a, b in zip(tree_leaves(tree), tree_leaves(back)):
+        assert a.shape == b.shape and a.dtype == b.dtype
+        # bf16/int leaves round-trip through f32 exactly (f32 holds both)
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_flatten_roundtrip_with_padding_drops_tail():
+    tree = _awkward_tree()
+    layout = tree_layout(tree)
+    plan = plan_buckets(layout.total, 64, align=16)
+    padded = pad_flat(tree_to_flat(tree), plan)
+    assert padded.shape == (plan.padded_total,)
+    back = flat_to_tree(layout, padded)
+    for a, b in zip(tree_leaves(tree), tree_leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_plan_geometry_invariants():
+    for total, bb, align in [
+        (1000, 256, 16), (1000, 0, 64), (1, 4, 8), (4096, 4096, 1),
+        (100, 4, 1),
+    ]:
+        plan = plan_buckets(total, bb, align=align)
+        # full disjoint cover of the padded buffer, in order
+        assert plan.starts[0] == 0
+        assert sum(plan.sizes) == plan.padded_total
+        for s, z, s_next in zip(
+            plan.starts, plan.sizes, plan.starts[1:] + (plan.padded_total,)
+        ):
+            assert s + z == s_next
+        assert plan.padded_total >= max(total, 1)
+        assert plan.padded_total % align == 0
+        # every boundary block-aligned; no bucket exceeds the byte budget
+        # by more than one block's padding
+        for s, z in zip(plan.starts, plan.sizes):
+            assert s % align == 0
+            if bb:
+                assert z * 4 <= max(bb, align * 4) + align * 4
+        if bb == 0:
+            assert plan.n_buckets == 1
+
+
+def test_plan_rejects_negative():
+    with pytest.raises(ValueError):
+        plan_buckets(100, -1)
+    with pytest.raises(ValueError):
+        PSConfig(num_workers=4, bucket_bytes=-2)
+
+
+def test_piece_stream_key_ids_are_position_stable():
+    tree = {"a": jnp.ones((24,)), "b": jnp.ones((8,))}
+    # legacy per-leaf: enumeration order (the discipline EF residuals
+    # already mirror)
+    _, ids, _ = piece_stream(tree, None)
+    assert tuple(ids) == (0, 1)
+    # bucketed: the bucket START OFFSET, not the bucket index
+    # (24+8=32 total elems, align 4, 64 B = 16-elem buckets -> 2 buckets)
+    pieces, ids, rebuild = piece_stream(tree, 64, align=4)
+    assert tuple(ids) == (0, 16)
+    assert [p.shape[0] for p in pieces] == [16, 16]
+    back = rebuild(pieces)
+    for a, b in zip(tree_leaves(tree), tree_leaves(back)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+# ------------------------------------------------------- mesh-level parity
+
+def _grad_tree(v):
+    """A worker-dependent gradient pytree with odd/empty/nested leaves."""
+    w = v[0]
+    return {
+        "conv": (w + 1.0) * jnp.linspace(-1.0, 1.0, 250).reshape(25, 10),
+        "bias": jnp.full((33,), w * 0.25),
+        "empty": jnp.zeros((0,)),
+        "nest": {"g": jnp.cos(w + jnp.arange(70, dtype=jnp.float32))},
+    }
+
+
+def _run_agg(mesh, fn):
+    vals = jnp.arange(N, dtype=jnp.float32).reshape(N, 1)
+    mapped = jax.shard_map(
+        fn, mesh=mesh, in_specs=(P(WORKER_AXIS),), out_specs=P(),
+        check_vma=False,
+    )
+    return jax.device_get(mapped(vals))
+
+
+def test_none_compress_bucketed_bit_exact_vs_per_leaf(mesh):
+    def fn(v):
+        g = _grad_tree(v)
+        out = {"leaf": aggregate_gradients(dict(g), WORKER_AXIS, N)}
+        for bb in (0, 256, 4096):
+            out[f"bb{bb}"] = aggregate_gradients(
+                dict(g), WORKER_AXIS, N, bucket_bytes=bb
+            )
+        return out
+
+    res = _run_agg(mesh, fn)
+    ref = tree_leaves(res["leaf"])
+    for key in ("bb0", "bb256", "bb4096"):
+        for a, b in zip(ref, tree_leaves(res[key])):
+            np.testing.assert_array_equal(a, b)
+
+
+def test_int8_bucketed_within_quantization_spec(mesh):
+    """Per-bucket scales bound the error exactly like per-tensor scales
+    bound the per-leaf wire: per worker, |err| <= scale/2 with nearest
+    rounding, and the psum of N such errors <= N * scale/2 / N = scale/2
+    after the mean."""
+    bsz = 32
+
+    def fn(v):
+        g = _grad_tree(v)
+        exact = aggregate_gradients(dict(g), WORKER_AXIS, N)
+        quant = aggregate_gradients(
+            dict(g), WORKER_AXIS, N, compress="int8",
+            quant_block_size=bsz, bucket_bytes=512,
+        )
+        errs = [
+            jnp.max(jnp.abs(a - b)) if a.size else jnp.float32(0.0)
+            for a, b in zip(tree_leaves(exact), tree_leaves(quant))
+        ]
+        # global absmax across the mesh bounds every block scale
+        absmax = jnp.max(jnp.stack([
+            jnp.max(jnp.abs(l)) if l.size else jnp.float32(0.0)
+            for l in tree_leaves(g)
+        ]))
+        return jnp.max(jnp.stack(errs)), jax.lax.pmax(absmax, WORKER_AXIS)
+
+    err, absmax = _run_agg(mesh, fn)
+    assert float(err) <= float(absmax) / 127.0 / 2 + 1e-6
+
+
+def test_int8_block_scales_invariant_to_bucket_carving(mesh):
+    """Block-quantized int8 (nearest): bucket boundaries are aligned to
+    the block size, so carving cannot move any block boundary — fused
+    (bb=0) and multi-bucket wires produce IDENTICAL values."""
+    bsz = 32
+
+    def fn(v):
+        g = _grad_tree(v)
+        out = {}
+        for bb in (0, 512):
+            out[f"bb{bb}"] = aggregate_gradients(
+                dict(g), WORKER_AXIS, N, compress="int8",
+                quant_block_size=bsz, bucket_bytes=bb,
+            )
+        return out
+
+    res = _run_agg(mesh, fn)
+    for a, b in zip(tree_leaves(res["bb0"]), tree_leaves(res["bb512"])):
+        np.testing.assert_array_equal(a, b)
+
+
+def test_stochastic_keys_fold_bucket_offset_not_leaf_index(mesh):
+    """The position-stability regression (satellite): two pytrees with
+    IDENTICAL flattened content but different leaf carvings must draw
+    identical stochastic-rounding noise when bucketed — the key folds
+    the bucket's flat offset, which is carving-invariant. (Per-leaf
+    legacy folds the enumeration index, where the same data carved
+    differently draws different noise — that is exactly why bucketed
+    key derivation must not reuse it.) Also pins run-to-run determinism
+    for every bucket_bytes setting."""
+    key = jax.random.key(7)
+
+    def fn(v):
+        base = (v[0] + 1.0) * jnp.linspace(-2.0, 2.0, 96)
+        tree_a = {"one": base}                       # 1 leaf
+        tree_b = {"x": base[:40], "y": base[40:]}    # same bytes, 2 leaves
+        out = {}
+        for tag, t in (("a", tree_a), ("b", tree_b)):
+            agg = aggregate_gradients(
+                t, WORKER_AXIS, N, compress="int8",
+                quant_rounding="stochastic", quant_key=key,
+                bucket_bytes=128,  # 32-elem buckets -> 3 buckets
+            )
+            out[tag] = jnp.concatenate(
+                [l.reshape(-1) for l in tree_leaves(agg)]
+            )
+        return out
+
+    res = _run_agg(mesh, fn)
+    np.testing.assert_array_equal(res["a"], res["b"])
+    res2 = _run_agg(mesh, fn)
+    np.testing.assert_array_equal(res["a"], res2["a"])
+
+
+# --------------------------------------------------------- train-step level
+
+def _batch(dataset, n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    shapes = {"MNIST": (28, 28, 1), "Cifar10": (32, 32, 3)}
+    return {
+        "image": rng.randint(0, 255, (n,) + shapes[dataset]).astype(np.uint8),
+        "label": rng.randint(0, 10, (n,)).astype(np.int32),
+    }
+
+
+def _train(mesh, cfg, steps=3, dataset="MNIST", lr=0.05):
+    shapes = {"MNIST": (28, 28, 1), "Cifar10": (32, 32, 3)}
+    model = build_model("LeNet")
+    tx = sgd(lr, momentum=0.9)
+    state = init_ps_state(
+        model, tx, cfg, jax.random.key(0), shapes[dataset]
+    )
+    state = shard_state(state, mesh, cfg)
+    step = make_ps_train_step(model, tx, cfg, mesh, donate=False)
+    b = shard_batch(_batch(dataset), mesh, cfg)
+    m = None
+    for _ in range(steps):
+        state, m = step(state, b, jax.random.key(1))
+    return jax.device_get(state.params), jax.device_get(m)
+
+
+def test_step_fused_bit_exact_vs_per_leaf(mesh):
+    """The flagship acceptance pin: the default guard-on replicated step
+    with one fused buffer produces bit-identical parameters to the
+    legacy per-leaf wire."""
+    p_leaf, _ = _train(mesh, PSConfig(num_workers=N))
+    p_fused, _ = _train(mesh, PSConfig(num_workers=N, bucket_bytes=0))
+    for a, b in zip(tree_leaves(p_leaf), tree_leaves(p_fused)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_step_multi_bucket_matches_per_leaf(mesh):
+    """Multi-bucket carving is the same math; XLA may reassociate
+    unrelated reductions across the two compilations (the fused guard
+    probe adds a consumer), so the step-level pin is allclose at f32
+    resolution — the COLLECTIVE-level pin above stays bit-exact."""
+    p_leaf, _ = _train(mesh, PSConfig(num_workers=N))
+    p_b, _ = _train(mesh, PSConfig(num_workers=N, bucket_bytes=65536))
+    for a, b in zip(tree_leaves(p_leaf), tree_leaves(p_b)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=1e-6, atol=1e-6
+        )
+
+
+def test_sharded_engine_fused_matches_legacy(mesh):
+    """ZeRO-1 on the buckets engine: bucket_bytes None (legacy spelling)
+    and 0 (fused) are the SAME wire — bit-exact, EF + block quant on."""
+    for compress, bsz, ef in ((None, 0, False), ("int8", 64, True)):
+        cfg = dict(
+            num_workers=N, opt_placement="sharded", compress=compress,
+            quant_block_size=bsz, error_feedback=ef,
+        )
+        p_none, _ = _train(mesh, PSConfig(**cfg))
+        p_zero, _ = _train(mesh, PSConfig(**cfg, bucket_bytes=0))
+        for a, b in zip(tree_leaves(p_none), tree_leaves(p_zero)):
+            np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_sharded_multi_bucket_runs_and_stays_close(mesh):
+    cfg = dict(
+        num_workers=N, opt_placement="sharded", compress="int8",
+        quant_block_size=64, error_feedback=True,
+    )
+    p_fused, m_f = _train(mesh, PSConfig(**cfg, bucket_bytes=0))
+    p_b, m_b = _train(mesh, PSConfig(**cfg, bucket_bytes=1 << 20))
+    assert np.isfinite(m_b["loss"])
+    # LeNet's ~1.7 MB payload -> 2 buckets; block boundaries unchanged,
+    # nearest rounding: identical quantization, identical result
+    for a, b in zip(tree_leaves(p_fused), tree_leaves(p_b)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_cifar_tiny_same_loss_envelope(mesh):
+    """int8 bucketed trains CIFAR-tiny inside the same loss envelope as
+    per-leaf int8: both descend, and their trajectories agree to a few
+    percent (nearest rounding keeps both runs deterministic)."""
+    from ps_pytorch_tpu.data import (
+        BatchIterator, make_preprocessor, make_synthetic,
+    )
+
+    ds = make_synthetic("Cifar10", train_size=256, test_size=32, seed=5)
+    losses = {}
+    for tag, bb in (("leaf", None), ("bucketed", 65536)):
+        cfg = PSConfig(
+            num_workers=N, compress="int8", quant_block_size=64,
+            bucket_bytes=bb,
+        )
+        model = build_model("LeNet")
+        tx = sgd(0.01, momentum=0.9)
+        state = init_ps_state(
+            model, tx, cfg, jax.random.key(0), (32, 32, 3)
+        )
+        state = shard_state(state, mesh, cfg)
+        pre = make_preprocessor("Cifar10", train=True)
+        step = make_ps_train_step(model, tx, cfg, mesh, preprocess=pre)
+        it = BatchIterator(
+            ds.train_images, ds.train_labels, batch_size=32, seed=0
+        )
+        run = []
+        for i, b in enumerate(it.forever()):
+            state, m = step(
+                state, shard_batch(b, mesh, cfg), jax.random.key(42)
+            )
+            run.append(float(m["loss"]))
+            if i >= 20:
+                break
+        losses[tag] = run
+    assert losses["bucketed"][-1] < losses["bucketed"][0] * 0.85, losses
+    assert losses["leaf"][-1] < losses["leaf"][0] * 0.85, losses
+    np.testing.assert_allclose(
+        losses["bucketed"][-1], losses["leaf"][-1], rtol=0.1
+    )
+
+
+def test_bucket_bytes_cli_flag_mapping():
+    """--bucket-bytes: -1 (default) = legacy per-leaf None, 0 = fused,
+    N = N-byte buckets."""
+    import argparse
+
+    from ps_pytorch_tpu.cli._flags import add_ps_flags, ps_config_from
+
+    parser = argparse.ArgumentParser()
+    add_ps_flags(parser)
+    for argv, want in (
+        ([], None),
+        (["--bucket-bytes", "0"], 0),
+        (["--bucket-bytes", "1048576"], 1 << 20),
+    ):
+        args = parser.parse_args(argv)
+        assert ps_config_from(args, 8).bucket_bytes == want

@@ -163,7 +163,7 @@ def fixture_contract(tmp_path_factory):
     data = json.loads(path.read_text())
     assert set(data["configs"]) == {
         "dead_axis", "metrics_only", "fat_f32_wire", "drift",
-        "undonated", "donate_mismatch", "ok_psum",
+        "undonated", "donate_mismatch", "defused", "ok_psum",
     }
     data["configs"]["drift"]["collectives"][0]["bytes"] += 1
     path.write_text(json.dumps(data))
@@ -179,6 +179,7 @@ def fixture_contract(tmp_path_factory):
         ("drift", "PSC104"),
         ("undonated", "PSC105"),
         ("donate_mismatch", "PSC105"),
+        ("defused", "PSC106"),
     ],
 )
 def test_fixture_trips_exactly_one_rule(fixture_contract, name, rule):
@@ -230,6 +231,8 @@ def test_cli_list_names_registry_configs():
     names = out.split()
     assert "ps_none_replicated" in names
     assert "ps_int8_2round_sharded" in names
+    assert "ps_int8_replicated_bucketed" in names
+    assert "ps_resnet18_int8_replicated_bucketed" in names
     assert "dp_tp_pp" in names
 
 
@@ -267,7 +270,7 @@ def test_check_sh_write_with_contract_value_is_not_refused(tmp_path):
     # rc 1: the broken fixtures trip their rules, but the write happened
     # (no exit-2 refusal from the shell gate)
     assert proc.returncode == 1, proc.stdout + proc.stderr
-    assert "wrote 7 config(s)" in proc.stdout
+    assert "wrote 8 config(s)" in proc.stdout
     assert out.exists()
 
 
@@ -322,6 +325,33 @@ def test_committed_contract_pins_an_int8_wire():
     assert any(
         r["kind"] == "all_gather" and r["dtype"] == "int8" for r in repl
     )
+
+
+def test_committed_contract_pins_bucketing_collapse():
+    """The fused-wire headline in artifact form: the replicated int8
+    ResNet config drops from one gradient psum per pytree leaf to
+    <= ceil(payload / bucket_bytes) bucketed psums."""
+    from ps_pytorch_tpu.check.contracts import (
+        RESNET_BUCKET_BYTES, payload_bytes,
+    )
+
+    committed = load_contract(str(CONTRACT))
+
+    def grad_psums(name):
+        rows = committed["configs"][name]["collectives"]
+        return sum(
+            r["count"] for r in rows
+            if r["kind"] == "psum" and r["dtype"] == "int32"
+        )
+
+    n_leaf = grad_psums("ps_resnet18_int8_replicated")
+    n_bucketed = grad_psums("ps_resnet18_int8_replicated_bucketed")
+    n_buckets = -(-payload_bytes("ResNet18") // RESNET_BUCKET_BYTES)
+    assert n_leaf > 50, n_leaf       # one per leaf (62 for ResNet18)
+    assert n_bucketed <= n_buckets, (n_bucketed, n_buckets)
+    # and the fused LeNet variants collapse to exactly one reduce
+    for name in ("ps_int8_replicated_bucketed",):
+        assert grad_psums(name) == 1, committed["configs"][name]
 
 
 def test_check_sh_gate_passes():

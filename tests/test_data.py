@@ -121,6 +121,29 @@ def test_prefetch_to_device():
     assert isinstance(out[0]["image"], jax.Array)
 
 
+def test_prefetch_to_device_with_sharding():
+    """Passing a NamedSharding lands prefetched batches pre-split across
+    the mesh (leading dim over the worker axis) — the train path's
+    layout, no re-shard inside the step; values are untouched."""
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from ps_pytorch_tpu.parallel.mesh import WORKER_AXIS, make_mesh
+
+    ds = make_synthetic("MNIST", train_size=64, test_size=8)
+    it = BatchIterator(ds.train_images, ds.train_labels, batch_size=16,
+                       shuffle=False)
+    mesh = make_mesh(num_workers=8)
+    sharding = NamedSharding(mesh, P(WORKER_AXIS))
+    out = list(prefetch_to_device(it.epoch(), device=sharding))
+    assert len(out) == 4
+    for b in out:
+        assert b["image"].sharding.is_equivalent_to(sharding, b["image"].ndim)
+        assert b["label"].sharding.is_equivalent_to(sharding, b["label"].ndim)
+    np.testing.assert_array_equal(
+        np.asarray(out[0]["image"]), ds.train_images[:16]
+    )
+
+
 def test_native_gather_matches_numpy():
     from ps_pytorch_tpu.data.loader import gather_rows
 
