@@ -171,15 +171,25 @@ def _bench_decode(steps: int) -> tuple:
     # comment in main()) cannot retire call N before call N-1, so the
     # final host_sync bounds ALL steps.
     key = jax.random.key(2)
-    out = gen(params, prompt, key)
+    # ONE compile via the AOT path: warmup, the timed loop, and the
+    # op-count probe all share it (a second jit-cache compile of the
+    # KV-cache scan would dominate smoke-window startup)
+    compiled = gen.lower(params, prompt, key).compile()
+    try:
+        from ps_pytorch_tpu.check.opcount import hlo_op_count
+
+        hlo_ops = hlo_op_count(compiled.as_text())
+    except Exception:
+        hlo_ops = None
+    out = compiled(params, prompt, key)
     host_sync(out)
     t0 = time.perf_counter()
     for _ in range(steps):
-        out = gen(params, prompt, key)
+        out = compiled(params, prompt, key)
         prompt = prompt.at[:, 0].set(out[:, -1] % cfg.vocab_size)
     host_sync(out, prompt)
     elapsed = time.perf_counter() - t0
-    return batch * n_new * steps / elapsed, elapsed
+    return batch * n_new * steps / elapsed, elapsed, hlo_ops
 
 
 def _bench_dtype(jnp, default: str):
@@ -254,6 +264,18 @@ def _bucket_tag() -> str:
         return "_ab_bucketing"
     bb = _bench_bucket_bytes()
     return "" if bb is None else f"_bkt{bb}"
+
+
+# BENCH_AB_STATE_LAYOUT=1 runs the CNN workload TWICE in one process —
+# PSConfig.state_layout="tree" then "flat" — and emits both in ONE record
+# (same shape as the bucketing A/B), each variant carrying its compiled
+# hlo_op_count and jaxpr update-path op count so the trajectory JSONs
+# capture the update-path collapse, not just walltime. Mutually exclusive
+# with BENCH_AB_BUCKETING (one A/B dimension per record).
+def _layout_tag() -> str:
+    if os.environ.get("BENCH_AB_STATE_LAYOUT") == "1":
+        return "_ab_state_layout"
+    return ""
 
 
 def _comm_contract_entry(workload: str, compress, bucket_bytes):
@@ -341,7 +363,7 @@ def _bench_lm(steps: int) -> tuple:
     for _ in range(2):
         params, opt, loss = step(params, opt, tok)
     host_sync(params, loss)
-    flops = _step_flops(step, params, opt, tok)
+    flops, hlo_ops = _step_cost(step, params, opt, tok)
     # never exceed the requested budget: BENCH_STEPS trims smoke runs on
     # timeout-bounded windows, so a 10-deep default chain must shrink to
     # the request rather than 4x it (non-multiples floor to outer*k)
@@ -359,7 +381,7 @@ def _bench_lm(steps: int) -> tuple:
         host_sync(params, loss)
         elapsed = time.perf_counter() - t0
     return (batch * seq * steps / elapsed, float(loss), elapsed, flops,
-            n_sp, steps, k)
+            n_sp, steps, k, hlo_ops)
 
 
 # Peak dense matmul FLOP/s per chip keyed by exact (generation, variant)
@@ -390,20 +412,33 @@ def _peak_flops_per_sec(device) -> float | None:
     return _PEAK_BY_GEN.get((m.group(1), variant))
 
 
-def _step_flops(step, *args) -> float | None:
-    """Total HLO FLOPs of one compiled step via XLA cost analysis.
+def _step_cost(step, *args) -> tuple:
+    """(flops, hlo_op_count) of one compiled step — XLA cost analysis for
+    the FLOPs, an instruction count of the optimized HLO for the size
+    (ps_pytorch_tpu.check.opcount). One .lower().compile() serves both.
 
-    This counts executed FLOPs (including rematerialized recompute), so the
-    derived MFU is hardware-FLOPs utilization, a slight overcount of
-    model-FLOPs MFU when remat is on.
+    The FLOP count includes rematerialized recompute, so the derived MFU
+    is hardware-FLOPs utilization, a slight overcount of model-FLOPs MFU
+    when remat is on. hlo_op_count rides every bench record so the
+    trajectory JSONs capture program-size changes (e.g. the
+    state_layout=flat update-path collapse), not just walltime.
     """
     try:
-        cost = step.lower(*args).compile().cost_analysis()
+        compiled = step.lower(*args).compile()
+        cost = compiled.cost_analysis()
         if isinstance(cost, (list, tuple)):  # older jax returns [dict]
             cost = cost[0]
-        return float(cost["flops"])
+        flops = float(cost["flops"])
     except Exception:
-        return None
+        return None, None
+    # separate guard: an opcount failure must not take the long-standing
+    # flops/mfu fields down with it
+    try:
+        from ps_pytorch_tpu.check.opcount import hlo_op_count
+
+        return flops, hlo_op_count(compiled.as_text())
+    except Exception:
+        return flops, None
 
 
 def _mfu(flops_per_step, steps, elapsed, jax, n_devices) -> float | None:
@@ -503,9 +538,11 @@ def _validate_env() -> None:
             )
     # AB=0 is the documented "off" value — as inert as unset, so a CI
     # wrapper exporting it globally must not abort the lm/decode legs
-    for knob in ("BENCH_BUCKET_BYTES", "BENCH_AB_BUCKETING"):
+    for knob in ("BENCH_BUCKET_BYTES", "BENCH_AB_BUCKETING",
+                 "BENCH_AB_STATE_LAYOUT"):
         val = os.environ.get(knob)
-        if knob == "BENCH_AB_BUCKETING" and val == "0":
+        if knob in ("BENCH_AB_BUCKETING", "BENCH_AB_STATE_LAYOUT") \
+                and val == "0":
             val = None
         if val is not None and os.environ.get(
             "BENCH_WORKLOAD", "lenet"
@@ -514,6 +551,12 @@ def _validate_env() -> None:
                 f"{knob} only applies to the CNN (PS) workloads; "
                 "it would be silently ignored for lm/decode"
             )
+    if (os.environ.get("BENCH_AB_BUCKETING") == "1"
+            and os.environ.get("BENCH_AB_STATE_LAYOUT") == "1"):
+        raise SystemExit(
+            "BENCH_AB_BUCKETING and BENCH_AB_STATE_LAYOUT are mutually "
+            "exclusive — one A/B dimension per record"
+        )
     if os.environ.get("BENCH_BUCKET_BYTES") is not None:
         try:
             bb = int(os.environ["BENCH_BUCKET_BYTES"])
@@ -527,11 +570,11 @@ def _validate_env() -> None:
                 "BENCH_BUCKET_BYTES must be >= 0 (unset it for the "
                 "legacy per-leaf wire)"
             )
-    if os.environ.get("BENCH_AB_BUCKETING") not in (None, "0", "1"):
-        raise SystemExit(
-            f"BENCH_AB_BUCKETING must be 0 or 1, "
-            f"got {os.environ['BENCH_AB_BUCKETING']!r}"
-        )
+    for knob in ("BENCH_AB_BUCKETING", "BENCH_AB_STATE_LAYOUT"):
+        if os.environ.get(knob) not in (None, "0", "1"):
+            raise SystemExit(
+                f"{knob} must be 0 or 1, got {os.environ[knob]!r}"
+            )
     if os.environ.get("BENCH_WORKLOAD", "lenet") not in WORKLOADS:
         raise SystemExit(
             f"BENCH_WORKLOAD must be one of {sorted(WORKLOADS)}, "
@@ -562,7 +605,7 @@ def _success_metric() -> str:
         return f"decode_{_dec_tag()}_new_tokens_per_sec"
     metric = WORKLOADS.get(name, {}).get("metric") or f"{name}_train_throughput"
     _, ctag = _cnn_compress(WORKLOADS.get(name, {}).get("compress"))
-    return metric + ctag + _bucket_tag() + _cnn_dtype_suffix()
+    return metric + ctag + _bucket_tag() + _layout_tag() + _cnn_dtype_suffix()
 
 
 def _attach_banked(rec: dict) -> None:
@@ -606,7 +649,6 @@ def main() -> None:
 
     from ps_pytorch_tpu.data import IMAGE_SHAPES, make_preprocessor, make_synthetic
     from ps_pytorch_tpu.models import build_model
-    from ps_pytorch_tpu.optim import sgd
     from ps_pytorch_tpu.parallel import (
         PSConfig,
         init_ps_state,
@@ -631,7 +673,7 @@ def main() -> None:
     if name == "lm":
         steps = int(os.environ.get("BENCH_STEPS", 20))
         (tokens_per_sec, loss, elapsed, flops, lm_dev, steps,
-         chain_used) = _bench_lm(steps)
+         chain_used, hlo_ops) = _bench_lm(steps)
         assert np.isfinite(loss), f"non-finite loss {loss}"
         rec = {
             "metric": _success_metric() + suffix,
@@ -641,6 +683,7 @@ def main() -> None:
             "mfu": _mfu(flops, steps, elapsed, jax, n_devices=lm_dev),
             "device": device_kind,
             "timestamp": _utc_now(),
+            "hlo_op_count": hlo_ops,
             # comm shape rides only the PS (CNN) records — the lm
             # workload's dp_sp scheme has no entry in the PS contract
             "comm": None,
@@ -658,7 +701,7 @@ def main() -> None:
         return
     if name == "decode":
         steps = int(os.environ.get("BENCH_STEPS", 10))
-        tokens_per_sec, elapsed = _bench_decode(steps)
+        tokens_per_sec, elapsed, dec_hlo_ops = _bench_decode(steps)
         rec = {
             "metric": _success_metric() + suffix,
             "value": round(tokens_per_sec, 1),
@@ -669,6 +712,7 @@ def main() -> None:
             "mfu": None,  # decode is KV-cache-bandwidth-bound by design
             "device": device_kind,
             "timestamp": _utc_now(),
+            "hlo_op_count": dec_hlo_ops,
             "comm": None,  # serving path: no gradient wire at all
         }
         if fallback:
@@ -689,7 +733,6 @@ def main() -> None:
     from ps_pytorch_tpu.utils import host_sync
 
     _, cnn_dtype = _bench_dtype(jnp, _CNN_DTYPE_DEFAULT)
-    tx = sgd(0.01, momentum=0.9)
     shape = IMAGE_SHAPES[w["dataset"]]
     pre = make_preprocessor(w["dataset"], train=True)
     ds = make_synthetic(w["dataset"], train_size=w["batch"], test_size=8, seed=0)
@@ -699,12 +742,21 @@ def main() -> None:
     # throughput extrapolates, the baseline comparison stays per-image.
     req_steps = int(os.environ.get("BENCH_STEPS", REF_STEPS))
 
-    def run_variant(bucket_bytes):
-        """Measure one wire granularity end to end; returns the variant's
-        sub-record plus (loss, elapsed, steps, flops, chain)."""
+    def run_variant(bucket_bytes, state_layout="flat",
+                    probe_update_path=False):
+        """Measure one (wire granularity, state layout) end to end;
+        returns the variant's sub-record plus (loss, elapsed, steps,
+        flops, chain)."""
+        from ps_pytorch_tpu.optim import build_optimizer
+
         cfg = PSConfig(
             num_workers=n_dev, compress=compress,
-            bucket_bytes=bucket_bytes,
+            bucket_bytes=bucket_bytes, state_layout=state_layout,
+        )
+        # the flat layout takes the whole-vector optimizer variant (the
+        # trainer's own pairing); the math is bit-identical either way
+        tx = build_optimizer(
+            "sgd", 0.01, momentum=0.9, flat=(state_layout == "flat")
         )
         model = build_model(w["network"], dtype=cnn_dtype)
         state = init_ps_state(model, tx, cfg, jax.random.key(0), shape)
@@ -720,7 +772,14 @@ def main() -> None:
         for _ in range(2):
             state, metrics = step(state, sharded, key)
         host_sync(state.params, metrics)
-        flops = _step_flops(step, state, sharded, key)
+        flops, hlo_ops = _step_cost(step, state, sharded, key)
+        update_ops = None
+        if probe_update_path:
+            from ps_pytorch_tpu.check.opcount import update_path_op_count
+
+            # jaxpr ops downstream of the gradient reduce — the count
+            # the flat state layout collapses (trace-only, no compile)
+            update_ops = update_path_op_count(step, state, sharded, key)
         steps = req_steps
         k = min(_chain(), steps)  # same budget clamp as the lm path
         if k > 1:
@@ -744,10 +803,14 @@ def main() -> None:
             "images_per_sec": round(images_per_sec, 1),
             "step_time_s": round(elapsed / steps, 6),
             "bucket_bytes": bucket_bytes,
+            "state_layout": state_layout,
+            "hlo_op_count": hlo_ops,
             # comm shape from the committed pscheck artifact, so the
             # perf trajectory records the wire, not just walltime
             "comm": _comm_contract_entry(name, compress, bucket_bytes),
         }
+        if update_ops is not None:
+            sub["update_path_ops"] = update_ops
         return sub, loss, elapsed, steps, flops, k
 
     if os.environ.get("BENCH_AB_BUCKETING") == "1":
@@ -767,6 +830,7 @@ def main() -> None:
             "mfu": _mfu(flops, steps, elapsed, jax, n_devices=n_dev),
             "device": device_kind,
             "timestamp": _utc_now(),
+            "hlo_op_count": sub_bkt["hlo_op_count"],
             # schema stability: every record carries "comm"; the A/B
             # comm shapes live per-variant under ab_bucketing
             "comm": sub_bkt["comm"],
@@ -777,6 +841,48 @@ def main() -> None:
                     sub_bkt["images_per_sec"]
                     / max(sub_leaf["images_per_sec"], 1e-9),
                     3,
+                ),
+            },
+        }
+    elif os.environ.get("BENCH_AB_STATE_LAYOUT") == "1":
+        # A/B leg: tree vs flat STATE in one process on the same data and
+        # the same wire (bucket_bytes is whatever the env selected for
+        # both variants) — walltime, compiled program size, and the
+        # update-path op count all land in one record. Headline = flat.
+        bb = _bench_bucket_bytes()
+        sub_tree, *_ = run_variant(
+            bb, state_layout="tree", probe_update_path=True
+        )
+        sub_flat, loss, elapsed, steps, flops, k = run_variant(
+            bb, state_layout="flat", probe_update_path=True
+        )
+        images_per_sec = sub_flat["images_per_sec"]
+        rec = {
+            "metric": _success_metric() + suffix,
+            "value": images_per_sec,
+            "unit": "images/sec",
+            "vs_baseline": round(images_per_sec / REF_IMAGES_PER_SEC, 2),
+            "mfu": _mfu(flops, steps, elapsed, jax, n_devices=n_dev),
+            "device": device_kind,
+            "timestamp": _utc_now(),
+            "hlo_op_count": sub_flat["hlo_op_count"],
+            "comm": sub_flat["comm"],
+            "ab_state_layout": {
+                "tree": sub_tree,
+                "flat": sub_flat,
+                "speedup": round(
+                    sub_flat["images_per_sec"]
+                    / max(sub_tree["images_per_sec"], 1e-9),
+                    3,
+                ),
+                "update_path_ops_ratio": (
+                    round(
+                        sub_tree["update_path_ops"]
+                        / max(sub_flat["update_path_ops"], 1), 2,
+                    )
+                    if sub_tree.get("update_path_ops")
+                    and sub_flat.get("update_path_ops")
+                    else None
                 ),
             },
         }
@@ -794,6 +900,7 @@ def main() -> None:
             "device": device_kind,
             "timestamp": _utc_now(),
             "step_time_s": sub["step_time_s"],
+            "hlo_op_count": sub["hlo_op_count"],
             "comm": sub["comm"],
         }
     if k > 1:

@@ -35,6 +35,7 @@ from .core import (
     trace_spec,
     write_contract,
 )
+from .opcount import compiled_op_count, hlo_op_count, update_path_op_count
 from .rules import RULE_IDS
 from .walker import Collective, collect_collectives, summarize
 
@@ -51,12 +52,15 @@ __all__ = [
     "WireAllowance",
     "WirePolicy",
     "collect_collectives",
+    "compiled_op_count",
     "get_contracts",
+    "hlo_op_count",
     "load_contract",
     "run_checks",
     "summarize",
     "to_contract_json",
     "trace_registry",
     "trace_spec",
+    "update_path_op_count",
     "write_contract",
 ]

@@ -232,6 +232,7 @@ def _ps_spec(
     dcn_hosts: int = 1,
     bucket_bytes: Optional[int] = None,
     network: str = "LeNet",
+    state_layout: str = "flat",
 ) -> ContractSpec:
     from ..parallel.mesh import DCN_AXIS, WORKER_AXIS
 
@@ -242,6 +243,11 @@ def _ps_spec(
         name = name.replace("ps_", f"ps_{network.lower()}_", 1)
     if bucket_bytes is not None:
         name += "_bucketed"
+    if state_layout != "flat":
+        # layout-parity twins only (layout_parity_pairs) — the registry
+        # itself carries the default layout, and state layout is
+        # compute-side, so its wire rows would duplicate the flat ones
+        name += "_treestate"
     axes: Tuple[str, ...] = (
         (DCN_AXIS, WORKER_AXIS) if dcn_hosts > 1 else (WORKER_AXIS,)
     )
@@ -255,6 +261,7 @@ def _ps_spec(
             opt_placement=placement,
             dcn_hosts=dcn_hosts,
             bucket_bytes=bucket_bytes,
+            state_layout=state_layout,
         )
 
     def build() -> Built:
@@ -493,6 +500,30 @@ def _dp_tp_pp_spec() -> ContractSpec:
 # collectives. MiB-scale buckets amortize collective latency without
 # blowing up program size; tiny buckets on big models de-fuse again.
 RESNET_BUCKET_BYTES = 4 << 20
+
+
+def layout_parity_pairs() -> Tuple[Tuple[ContractSpec, ContractSpec], ...]:
+    """(flat_spec, tree_spec) twins for the state-layout parity gate.
+
+    PSConfig.state_layout is COMPUTE-side: the registry (and the
+    committed artifact) trace the default flat layout, and these twins
+    exist so tests/test_flat_state.py can assert that each pair's traced
+    wire accounting — collective kinds, axes, dtypes, counts, bytes — is
+    byte-identical, i.e. going flat moved zero bytes and added zero
+    collectives. One twin per wire family: the per-leaf psum, the fused
+    quantized bucket wire, and the ZeRO-1 scatter."""
+    combos = (
+        dict(compress=None, placement="replicated"),
+        dict(compress="int8", placement="replicated", bucket_bytes=0),
+        dict(compress="int8", placement="sharded"),
+    )
+    return tuple(
+        (
+            _ps_spec(state_layout="flat", **kw),
+            _ps_spec(state_layout="tree", **kw),
+        )
+        for kw in combos
+    )
 
 
 def get_contracts() -> Tuple[ContractSpec, ...]:

@@ -123,6 +123,14 @@ def add_ps_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "aligned to the quantization block "
                              "(O(n_buckets) collectives instead of "
                              "O(n_leaves); parallel/buckets.py)")
+    parser.add_argument("--state-layout", type=str, default="flat",
+                        choices=("tree", "flat"),
+                        help="where master params/optimizer moments live: "
+                             "flat (default) = padded flat f32 vectors in "
+                             "the wire's bucket geometry (one fused vector "
+                             "update per step), tree = legacy per-leaf "
+                             "pytree. Compute-side only — wire bytes and "
+                             "checkpoints are identical either way")
     parser.add_argument("--quant-rounding", type=str, default="nearest",
                         choices=("nearest", "stochastic"),
                         help="stochastic = unbiased gradient quantization")
@@ -206,6 +214,7 @@ def ps_config_from(args: argparse.Namespace, num_workers: int) -> PSConfig:
         bucket_bytes=(
             None if args.bucket_bytes < 0 else args.bucket_bytes
         ),
+        state_layout=args.state_layout,
         error_feedback=args.error_feedback,
         opt_placement=args.opt_placement,
         bn_mode=args.bn_mode,

@@ -23,7 +23,12 @@ import jax
 import jax.numpy as jnp
 
 from .. import checkpoint as ckpt
-from ..data import BatchIterator, make_preprocessor, prepare_data
+from ..data import (
+    BatchIterator,
+    make_preprocessor,
+    prefetch_to_device,
+    prepare_data,
+)
 from ..models import apply_model, build_model, init_model, input_shape_for
 from ..ops.metrics import accuracy, cross_entropy_loss
 from ..trainer import average_metrics
@@ -88,11 +93,17 @@ class Evaluator:
             self.eval_batch_size,
             shuffle=False,
         )
+        # same prefetch path as the trainer (data.prefetch_to_device):
+        # batch k+1's host->device transfer overlaps eval on batch k.
+        # This evaluator runs the model on ONE device, so the default
+        # placement is the sharding here; a mesh consumer passes
+        # parallel.batch_sharding instead (trainer.validate does).
+        prefetched = prefetch_to_device(iter(it), size=2)
         out = average_metrics(
             lambda b: self._eval_fn(
-                params, batch_stats, jnp.asarray(b["image"]), jnp.asarray(b["label"])
+                params, batch_stats, b["image"], b["label"]
             ),
-            it,
+            prefetched,
         )
         logger.info(format_eval_line(step, out["loss"], out["prec1"], out["prec5"]))
         return out

@@ -10,8 +10,8 @@ from __future__ import annotations
 
 import optax
 
-from .adam import AdamState, adam
-from .sgd import SGDState, sgd
+from .adam import AdamState, adam, adam_flat
+from .sgd import SGDState, sgd, sgd_flat
 
 OPTIMIZER_REGISTRY = ("sgd", "adam", "amsgrad")
 
@@ -26,10 +26,18 @@ def build_optimizer(
     b1: float = 0.9,
     b2: float = 0.999,
     eps: float = 1e-8,
+    flat: bool = False,
 ) -> optax.GradientTransformation:
+    """``flat=True`` returns the whole-vector variant (sgd_flat/adam_flat)
+    for ``PSConfig.state_layout="flat"`` — bit-identical math on the
+    padded flat state, no per-leaf tree_map. The tree transforms also
+    ACCEPT flat operands (a tree_map over one vector leaf is one vector
+    op), so flat is an explicitness/efficiency choice, not a correctness
+    requirement."""
     name = name.lower()
     if name == "sgd":
-        return sgd(
+        make = sgd_flat if flat else sgd
+        return make(
             learning_rate,
             momentum=momentum,
             dampening=dampening,
@@ -37,7 +45,8 @@ def build_optimizer(
             nesterov=nesterov,
         )
     if name in ("adam", "amsgrad"):
-        return adam(
+        make = adam_flat if flat else adam
+        return make(
             learning_rate,
             b1=b1,
             b2=b2,

@@ -71,6 +71,7 @@ from .ulysses import (
     make_ulysses_attention,
     ulysses_attention,
 )
+from .buckets import FlatVector, tree_view
 from .ps import (
     PSConfig,
     PSTrainState,
@@ -80,5 +81,6 @@ from .ps import (
     make_ps_train_step,
     shard_batch,
     shard_state,
+    state_plan,
     state_specs,
 )

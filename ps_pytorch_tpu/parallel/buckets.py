@@ -30,6 +30,17 @@ START OFFSET in the flat buffer (``BucketPlan.starts``), not by its
 enumeration index — position-stable derivation, so a bucket's noise
 stream is a function of where its bytes live, not of how many buckets
 precede it (collectives.py ``key_offsets``).
+
+``FlatVector`` is the third layer (PSConfig.state_layout="flat"): a
+param-shaped quantity — master params, an optimizer moment — stored AS
+the padded flat f32 vector, with its TreeLayout/BucketPlan riding along
+as static pytree metadata. The tree view exists only where the forward
+pass needs it (``flat_to_tree``, slices XLA fuses away); the optimizer
+update, the non-finite-guard rollback, and the wire all operate on the
+whole vector. Checkpoints stay TREE-SHAPED at the save/restore boundary:
+FlatVector registers flax serialization handlers that convert at the
+edge, so checkpoints are bit-portable across ``state_layout`` (and
+``bucket_bytes``), and pre-flat-state checkpoints load unchanged.
 """
 
 from __future__ import annotations
@@ -37,8 +48,11 @@ from __future__ import annotations
 import dataclasses
 from typing import Any, List, Sequence, Tuple
 
+import flax.struct
 import jax
 import jax.numpy as jnp
+import numpy as np
+from flax import serialization
 
 
 def _align_up(n: int, align: int) -> int:
@@ -171,7 +185,102 @@ def pad_flat(flat: jax.Array, plan: BucketPlan) -> jax.Array:
     return jnp.pad(flat, (0, plan.padded_total - plan.total))
 
 
-def piece_stream(tree, bucket_bytes, align: int = 1):
+@flax.struct.dataclass
+class FlatVector:
+    """One param-shaped quantity stored flat (state_layout="flat").
+
+    ``flat`` is the alignment-padded f32 vector in ``plan``'s geometry
+    (``plan.padded_total`` elements; the pad tail is zero and never feeds
+    the tree view). ``layout``/``plan`` are static aux data — part of the
+    pytree STRUCTURE, not leaves — so jit specializes on the geometry and
+    ``jax.tree_util.tree_map`` over a FlatVector is a whole-vector op.
+    That makes the existing optax-style transforms fused for free: a
+    ``tree_map`` over a single [P] leaf IS one vector op, and the guard's
+    rollback ``jnp.where`` selects the whole state in a handful of ops.
+
+    Serialization converts at the edge (see ``_flatvector_to_state_dict``
+    below): a FlatVector's state dict is the TREE-shaped nested dict of
+    its leaves, so checkpoints written from a flat-state run are
+    byte-compatible with tree-state runs and with pre-flat checkpoints.
+    """
+
+    flat: jax.Array
+    layout: TreeLayout = flax.struct.field(pytree_node=False)
+    plan: BucketPlan = flax.struct.field(pytree_node=False)
+
+    def tree(self):
+        """Materialize the tree view (slices/reshapes XLA fuses away)."""
+        return flat_to_tree(self.layout, self.flat)
+
+
+def tree_view(params):
+    """Tree view of a params-like object under either state layout."""
+    if isinstance(params, FlatVector):
+        return params.tree()
+    return params
+
+
+def to_flat_vector(tree, plan: BucketPlan) -> FlatVector:
+    """Pack a pytree into a FlatVector with ``plan``'s padding."""
+    return FlatVector(
+        flat=pad_flat(tree_to_flat(tree), plan),
+        layout=tree_layout(tree),
+        plan=plan,
+    )
+
+
+def _np_flat_to_tree(layout: TreeLayout, flat):
+    """Host-side (numpy) twin of flat_to_tree for the checkpoint edge —
+    serialization must not touch a device."""
+    flat = np.asarray(flat)
+    leaves = []
+    for shape, dtype, off in zip(layout.shapes, layout.dtypes,
+                                 layout.offsets):
+        n = 1
+        for d in shape:
+            n *= d
+        leaves.append(flat[off:off + n].reshape(shape).astype(dtype))
+    return jax.tree_util.tree_unflatten(layout.treedef, leaves)
+
+
+def _np_tree_to_flat(layout: TreeLayout, plan: BucketPlan, tree):
+    flat = np.zeros((plan.padded_total,), np.float32)
+    for leaf, off in zip(jax.tree_util.tree_leaves(tree), layout.offsets):
+        arr = np.asarray(leaf)
+        flat[off:off + arr.size] = arr.astype(np.float32).reshape(-1)
+    return flat
+
+
+def _flatvector_to_state_dict(fv: FlatVector):
+    # checkpoints are tree-shaped at the boundary: store the leaves, not
+    # the buffer, so the file is identical to a tree-state run's
+    return serialization.to_state_dict(
+        _np_flat_to_tree(fv.layout, fv.flat)
+    )
+
+
+def _flatvector_from_state_dict(fv: FlatVector, state) -> FlatVector:
+    # the stored dict is tree-shaped (this handler wrote it, or the
+    # checkpoint predates flat state); rebuild the padded vector in the
+    # TARGET's geometry — portability across bucket_bytes/state_layout
+    # falls out, because the tree is the interchange format
+    template = _np_flat_to_tree(
+        fv.layout, np.zeros((fv.plan.padded_total,), np.float32)
+    )
+    tree = serialization.from_state_dict(template, state)
+    return fv.replace(flat=_np_tree_to_flat(fv.layout, fv.plan, tree))
+
+
+serialization.register_serialization_state(
+    FlatVector,
+    _flatvector_to_state_dict,
+    _flatvector_from_state_dict,
+    override=True,  # flax.struct registered field-wise handlers already
+)
+
+
+def piece_stream(tree, bucket_bytes, align: int = 1,
+                 flat_output: bool = False):
     """The comm engine's one entry point: what a collective scheme ships.
 
     Returns ``(pieces, key_ids, rebuild)``:
@@ -189,10 +298,30 @@ def piece_stream(tree, bucket_bytes, align: int = 1):
       precede it);
     - ``rebuild``: maps the per-piece aggregation results (same shapes
       as ``pieces``) back to the original tree structure, restoring
-      every leaf's dtype/shape and dropping alignment padding.
+      every leaf's dtype/shape and dropping alignment padding — or, with
+      ``flat_output=True`` (state_layout="flat": the consumer is the
+      fused vector update, not a per-leaf optimizer), to ONE padded flat
+      f32 vector in the same ``align`` geometry, skipping the per-leaf
+      scatter entirely. The pieces (and therefore the wire) are
+      IDENTICAL either way — flat_output changes only the rebuild.
     """
     if bucket_bytes is None:
         leaves, treedef = jax.tree_util.tree_flatten(tree)
+        if flat_output:
+            layout = tree_layout(tree)
+            plan = plan_buckets(layout.total, 0, align=align)
+            return (
+                leaves,
+                tuple(range(len(leaves))),
+                lambda outs: pad_flat(
+                    concat_buckets(
+                        [o.astype(jnp.float32).reshape(-1) for o in outs]
+                    )
+                    if outs
+                    else jnp.zeros((0,), jnp.float32),
+                    plan,
+                ),
+            )
         return (
             leaves,
             tuple(range(len(leaves))),
@@ -201,8 +330,9 @@ def piece_stream(tree, bucket_bytes, align: int = 1):
     layout = tree_layout(tree)
     plan = plan_buckets(layout.total, bucket_bytes, align=align)
     pieces = split_buckets(pad_flat(tree_to_flat(tree), plan), plan)
-    return (
-        pieces,
-        plan.starts,
-        lambda outs: flat_to_tree(layout, concat_buckets(outs)),
+    rebuild = (
+        concat_buckets
+        if flat_output
+        else (lambda outs: flat_to_tree(layout, concat_buckets(outs)))
     )
+    return (pieces, plan.starts, rebuild)

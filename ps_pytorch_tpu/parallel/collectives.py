@@ -56,18 +56,25 @@ def aggregation_mask(
 
 
 def psum_mean(tree, axis_name: str, denominator: float,
-              bucket_bytes: Optional[int] = None):
+              bucket_bytes: Optional[int] = None,
+              flat_output: bool = False):
     """Sum over workers / denominator (parity: _model_update divides the
     aggregate buffer by num_aggregate, sync_replicas_master_nn.py:204-207).
 
     ``bucket_bytes`` (buckets.piece_stream) ships the fused flat f32
     buckets instead of the raw leaves — bit-exact for f32 gradients
     (same values, same elementwise sum/divide), and the collective
-    operands become a few contiguous buffers instead of one per leaf."""
-    if bucket_bytes is None:
+    operands become a few contiguous buffers instead of one per leaf.
+    ``flat_output`` (state_layout="flat") returns the aggregate as one
+    padded flat vector instead of scattering it back into the tree; the
+    collectives themselves are identical (jax batches a whole-tree psum
+    into one eqn either way)."""
+    if bucket_bytes is None and not flat_output:
         summed = lax.psum(tree, axis_name)
         return jax.tree_util.tree_map(lambda g: g / denominator, summed)
-    pieces, _, rebuild = piece_stream(tree, bucket_bytes)
+    pieces, _, rebuild = piece_stream(
+        tree, bucket_bytes, flat_output=flat_output
+    )
     summed = lax.psum(pieces, axis_name)  # one fused eqn over the buckets
     return rebuild([s / denominator for s in summed])
 
@@ -80,6 +87,7 @@ def quantized_psum(
     rounding: str = "nearest",
     key: Optional[jax.Array] = None,
     bucket_bytes: Optional[int] = None,
+    flat_output: bool = False,
 ):
     """int8-quantized gradient all-reduce.
 
@@ -118,7 +126,7 @@ def quantized_psum(
         return deq / denominator
 
     pieces, key_ids, rebuild = piece_stream(
-        tree, bucket_bytes, align=block_size or 1
+        tree, bucket_bytes, align=block_size or 1, flat_output=flat_output
     )
     return rebuild([one(i, g) for i, g in zip(key_ids, pieces)])
 
@@ -191,6 +199,7 @@ def quantized_allreduce_2round(
     rounding: str = "nearest",
     key: Optional[jax.Array] = None,
     bucket_bytes: Optional[int] = None,
+    flat_output: bool = False,
 ):
     """Two-round int8 all-reduce whose WIRE traffic is actually int8.
 
@@ -240,7 +249,7 @@ def quantized_allreduce_2round(
         return (deq[:total] / denominator).reshape(g.shape)
 
     pieces, key_ids, rebuild = piece_stream(
-        tree, bucket_bytes, align=block_size or 1
+        tree, bucket_bytes, align=block_size or 1, flat_output=flat_output
     )
     return rebuild([one(i, g) for i, g in zip(key_ids, pieces)])
 
@@ -254,6 +263,7 @@ def quantized_allreduce_2round_hier(
     rounding: str = "nearest",
     key: Optional[jax.Array] = None,
     bucket_bytes: Optional[int] = None,
+    flat_output: bool = False,
 ):
     """Hierarchical (DCN x ICI) bandwidth-honest int8 all-reduce that
     crosses DCN exactly ONCE per gradient element.
@@ -316,7 +326,7 @@ def quantized_allreduce_2round_hier(
         return (full[:total] / denominator).reshape(g.shape)
 
     pieces, key_ids, rebuild = piece_stream(
-        tree, bucket_bytes, align=block_size or 1
+        tree, bucket_bytes, align=block_size or 1, flat_output=flat_output
     )
     return rebuild([one(i, g) for i, g in zip(key_ids, pieces)])
 
@@ -374,6 +384,7 @@ def aggregate_gradients(
     return_contribution: bool = False,
     axis_sizes: Optional[tuple] = None,
     bucket_bytes: Optional[int] = None,
+    flat_output: bool = False,
 ):
     """The full PS aggregation: mask -> (bucket) -> (quantized) reduce -> / K.
 
@@ -382,6 +393,14 @@ def aggregate_gradients(
     buffer, ``N`` = ~N-byte buckets. Every scheme and the EF contribution
     share the same piece stream (buckets.piece_stream), so residuals
     mirror the transmitted values exactly in either granularity.
+
+    ``flat_output`` (state_layout="flat") returns the AGGREGATE as one
+    padded flat f32 vector — the shape the fused vector update consumes —
+    instead of scattering it back into the gradient tree. It is
+    compute-side only: the masking, quantization, and every collective
+    are byte-identical to the tree output, and the EF contribution (when
+    requested) stays TREE-shaped because the per-worker residual state is
+    per-leaf (checkpoint-portable across bucket/layout settings).
 
     return_contribution=True additionally returns THIS worker's
     transmitted (post-mask, post-quantization-round-trip) value — what
@@ -412,7 +431,8 @@ def aggregate_gradients(
         sel = aggregation_mask(axis_name, num_workers, num_aggregate, mask_key, mask_mode)
         grads = jax.tree_util.tree_map(lambda g: g * sel.astype(g.dtype), grads)
     if compress in (None, "none"):
-        agg = psum_mean(grads, axis_name, float(k), bucket_bytes=bucket_bytes)
+        agg = psum_mean(grads, axis_name, float(k),
+                        bucket_bytes=bucket_bytes, flat_output=flat_output)
         contribution = grads  # lossless transmit: residual is zero
     elif compress == "int8":
         agg = quantized_psum(
@@ -423,6 +443,7 @@ def aggregate_gradients(
             rounding=quant_rounding,
             key=quant_key,
             bucket_bytes=bucket_bytes,
+            flat_output=flat_output,
         )
         contribution = None
     elif hier_2round:
@@ -440,6 +461,7 @@ def aggregate_gradients(
             rounding=quant_rounding,
             key=quant_key,
             bucket_bytes=bucket_bytes,
+            flat_output=flat_output,
         )
         contribution = None
     elif compress == "int8_2round":
@@ -452,6 +474,7 @@ def aggregate_gradients(
             rounding=quant_rounding,
             key=quant_key,
             bucket_bytes=bucket_bytes,
+            flat_output=flat_output,
         )
         contribution = None
     else:

@@ -54,12 +54,15 @@ from ..resilience.guard import (
 )
 from .buckets import (
     BucketPlan,
+    FlatVector,
     concat_buckets,
     flat_to_tree,
     pad_flat,
     plan_buckets,
+    to_flat_vector,
     tree_layout,
     tree_to_flat,
+    tree_view,
 )
 from .collectives import aggregate_gradients, aggregation_mask
 from .mesh import WORKER_AXIS
@@ -100,6 +103,20 @@ class PSConfig:
     # bucketing on, the non-finite guard reduces ONE fused isfinite over
     # the flat buffer instead of one per leaf.
     bucket_bytes: Optional[int] = None
+    # where the master params and optimizer moments LIVE (buckets.
+    # FlatVector): "flat" (default) keeps them as padded flat f32
+    # vectors in the same BucketPlan geometry the wire uses — the
+    # reduced flat gradient feeds ONE fused vector update, the tree
+    # view the forward pass needs is materialized once per step
+    # (slices XLA fuses away), the non-finite guard's rollback selects
+    # a handful of whole vectors instead of every leaf, and the ZeRO-1
+    # path drops its per-step tree_to_flat(params) because params
+    # already live flat in shard geometry. "tree" is the legacy
+    # per-leaf layout. Compute-side only: the wire (collective counts,
+    # bytes, quantization noise) is byte-identical either way, and
+    # checkpoints are tree-shaped at the save/restore boundary, so
+    # they stay bit-portable across both settings.
+    state_layout: str = "flat"
     # error feedback (EF-SGD): each worker keeps the residual its
     # compression dropped and adds it back next step, so quantization
     # error accumulates into the update instead of being lost — the
@@ -160,6 +177,8 @@ class PSConfig:
             raise ValueError(f"bad compress {self.compress!r}")
         if self.quant_rounding not in ("nearest", "stochastic"):
             raise ValueError(f"bad quant_rounding {self.quant_rounding!r}")
+        if self.state_layout not in ("tree", "flat"):
+            raise ValueError(f"bad state_layout {self.state_layout!r}")
         if self.bucket_bytes is not None and self.bucket_bytes < 0:
             raise ValueError(
                 f"bad bucket_bytes {self.bucket_bytes} (None = per-leaf, "
@@ -217,7 +236,14 @@ class PSConfig:
 @flax.struct.dataclass
 class PSTrainState:
     step: jax.Array
+    # the master parameters: the model pytree (state_layout="tree") or a
+    # buckets.FlatVector — ONE padded flat f32 vector in the wire's
+    # BucketPlan geometry (state_layout="flat", the default). Either way
+    # checkpoints store the TREE shape (FlatVector converts at the
+    # serialization edge), so they are bit-portable across layouts.
     params: Any
+    # optax state; under "flat" + replicated placement the moments are
+    # FlatVectors too (same geometry, same tree-shaped checkpoint form)
     opt_state: Any
     batch_stats: Any
     # error-feedback residuals, worker-stacked [n, ...] per param leaf
@@ -271,6 +297,19 @@ def _zero1_shard_size(total: int, cfg: PSConfig) -> int:
     return _sharded_plan(cfg, total).padded_total // cfg.num_workers
 
 
+def state_plan(cfg: PSConfig, total: int) -> BucketPlan:
+    """The flat-state geometry (state_layout="flat"): the SAME BucketPlan
+    the config's gradient wire uses, so the reduced flat gradient drops
+    straight into the vector update with no re-layout. Replicated:
+    ``bucket_bytes`` carving aligned to ``wire_align`` (None = one fused
+    buffer — only the padding matters for state). Sharded: the ZeRO-1
+    scatter plan (alignment × num_workers), so params already live in
+    shard geometry."""
+    if cfg.opt_placement == "sharded":
+        return _sharded_plan(cfg, total)
+    return plan_buckets(total, cfg.bucket_bytes or 0, align=wire_align(cfg))
+
+
 def init_ps_state(
     model,
     tx: optax.GradientTransformation,
@@ -282,9 +321,16 @@ def init_ps_state(
     engine expects for the configured placement/bn modes."""
     from ..models import init_model
 
-    params, batch_stats = init_model(model, rng, input_shape)
+    params_tree, batch_stats = init_model(model, rng, input_shape)
+    total = _flat_padded_size(params_tree)
+    if cfg.state_layout == "flat":
+        # master params become ONE padded flat f32 vector in the wire's
+        # own BucketPlan geometry; the tree view is materialized per
+        # step inside the jitted program (and at the checkpoint edge)
+        params = to_flat_vector(params_tree, state_plan(cfg, total))
+    else:
+        params = params_tree
     if cfg.opt_placement == "sharded":
-        total = _flat_padded_size(params)
         shard = _zero1_shard_size(total, cfg)
         flat_zeros = jnp.zeros((shard,), jnp.float32)
         one_state = tx.init(flat_zeros)
@@ -293,6 +339,9 @@ def init_ps_state(
             lambda x: jnp.broadcast_to(x, (cfg.num_workers,) + jnp.shape(x)), one_state
         )
     else:
+        # under "flat", params is a FlatVector: moments initialize as
+        # whole padded vectors carrying the same static layout (the
+        # checkpoint edge converts them tree-shaped like the params)
         opt_state = tx.init(params)
     if cfg.bn_mode == "local" and batch_stats:
         batch_stats = tree_map(
@@ -303,18 +352,19 @@ def init_ps_state(
         if cfg.opt_placement == "sharded":
             # the sharded wire transforms the FLAT padded gradient vector,
             # so its residual lives there too: one [L] row per worker
-            total = _flat_padded_size(params)
             flat_len = _zero1_shard_size(total, cfg) * cfg.num_workers
             comm_state = jnp.zeros(
                 (cfg.num_workers, flat_len), jnp.float32
             )
         else:
-            # zero residual per worker per param leaf, worker-stacked
+            # zero residual per worker per param leaf, worker-stacked —
+            # per-leaf in BOTH state layouts, so EF checkpoints stay
+            # portable across bucket/layout settings
             comm_state = tree_map(
                 lambda p: jnp.zeros(
                     (cfg.num_workers,) + jnp.shape(p), jnp.float32
                 ),
-                params,
+                params_tree,
             )
     guard_state = None
     if cfg.nonfinite_guard:
@@ -411,6 +461,12 @@ def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key,
     collectives.piece_stream), so the noise stream a byte sees depends on
     where it lives, not on how many buckets precede it.
 
+    `params` may be the replicated tree (state_layout="tree": flattened
+    here, scattered back after the gather) or a FlatVector
+    (state_layout="flat": ALREADY in this wire's shard geometry — the
+    per-step tree_to_flat/flat_to_tree round trip disappears and the
+    gathered update adds straight onto the flat buffer).
+
     `err` (error feedback) is this worker's residual on the FLAT padded
     gradient vector; returns (new_params, new_opt, new_err)."""
     axis, n = cfg.axis_name, cfg.num_workers
@@ -487,7 +543,10 @@ def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key,
             )
             for start, size in zip(plan.starts, plan.sizes)
         ]) / k
-    flat_p = pad_flat(tree_to_flat(params), plan)
+    if isinstance(params, FlatVector):
+        flat_p = params.flat  # already padded in this plan's geometry
+    else:
+        flat_p = pad_flat(tree_to_flat(params), plan)
     p_shard = _worker_region(flat_p, plan, w, n)
     upd_shard, new_opt = tx.update(g_shard, opt_state, p_shard)
     # reassemble: each bucket's shard segment gathers back tiled, in
@@ -499,10 +558,15 @@ def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key,
             lax.slice(upd_shard, (off,), (off + s,)), axis, tiled=True
         ))
         off += s
-    upd_full = concat_buckets(full)[:total]
-    new_params = optax.apply_updates(
-        params, flat_to_tree(layout, upd_full)
-    )
+    if isinstance(params, FlatVector):
+        # flat state: one vector add, no per-leaf scatter (the pad tail
+        # stays zero — zero gradient => zero update)
+        new_params = params.replace(flat=flat_p + concat_buckets(full))
+    else:
+        upd_full = concat_buckets(full)[:total]
+        new_params = optax.apply_updates(
+            params, flat_to_tree(layout, upd_full)
+        )
     return new_params, new_opt, new_err
 
 
@@ -526,7 +590,16 @@ def make_ps_train_step(
     all-finite reduction over the gradients, one int32 pmin for mesh
     consensus (4 B on the wire, no host transfer), and a `jnp.where` select
     that turns the whole state update into the identity on a bad step —
-    the guard decision never leaves the device.
+    the guard decision never leaves the device. Under state_layout="flat"
+    that rollback selects a handful of whole flat vectors (params + each
+    optimizer moment) instead of every pytree leaf.
+
+    cfg.state_layout="flat" (default) keeps master params and optimizer
+    moments as padded flat f32 vectors end to end: the forward pass reads
+    a once-per-step tree view, the reduced flat gradient feeds one fused
+    vector update, and the ZeRO-1 path skips its per-step
+    tree_to_flat(params). Compute-side only — the wire is byte-identical
+    to "tree" (pscheck's layout-parity gate pins this).
 
     `faults` (resilience.FaultPlan) bakes deterministic NaN/Inf gradient
     injection into the compiled step at the planned global steps — the
@@ -553,6 +626,11 @@ def make_ps_train_step(
         params_in, opt_in, bs_in_raw, comm_in = (
             params, opt_state, batch_stats, comm_state
         )
+        # tree view for the forward/backward pass; under state_layout=
+        # "flat" this is the once-per-step flat_to_tree materialization
+        # (static slices/reshapes XLA fuses into the consumers), and the
+        # master `params` stays the padded flat vector end to end
+        params_t = tree_view(params)
         scale = (
             guard_state.scale
             if cfg.nonfinite_guard and cfg.dynamic_loss_scale
@@ -573,7 +651,7 @@ def make_ps_train_step(
                     loss = loss * scale
                 return loss, (logits, new_bs)
 
-            (loss, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(params)
+            (loss, aux), g = jax.value_and_grad(loss_fn, has_aux=True)(params_t)
             if scale is not None:
                 # unscale immediately: everything downstream (EF residual,
                 # quantization, the finite check) sees true-magnitude
@@ -608,7 +686,7 @@ def make_ps_train_step(
                 )
                 return carry, None
 
-            zeros = tree_map(jnp.zeros_like, params)
+            zeros = tree_map(jnp.zeros_like, params_t)
             (new_bs, gsum, lsum, p1sum, p5sum), _ = lax.scan(
                 micro,
                 (bs, zeros, 0.0, 0.0, 0.0),
@@ -671,6 +749,7 @@ def make_ps_train_step(
                 # backup-worker mode)
                 err = tree_map(lambda a: a[0], comm_state)
                 grads = tree_map(jnp.add, grads, err)
+            is_flat = cfg.state_layout == "flat"
             out = aggregate_gradients(
                 grads,
                 axis,
@@ -685,13 +764,21 @@ def make_ps_train_step(
                 return_contribution=cfg.error_feedback,
                 axis_sizes=hier_sizes,
                 bucket_bytes=cfg.bucket_bytes,
+                flat_output=is_flat,
             )
             if cfg.error_feedback:
+                # the contribution (and the residual it defines) stays
+                # per-leaf in both layouts — checkpoint portability
                 agg, contribution = out
                 new_err = tree_map(lambda a, b: a - b, grads, contribution)
                 new_comm = tree_map(lambda a: a[None], new_err)
             else:
                 agg = out
+            if is_flat:
+                # the reduced flat gradient, already in the state's
+                # BucketPlan geometry (piece_stream and state_plan share
+                # wire_align) — wrap it and run ONE fused vector update
+                agg = params.replace(flat=agg)
             updates, new_opt = tx.update(agg, opt_state, params)
             params = optax.apply_updates(params, updates)
 
@@ -791,7 +878,7 @@ def make_ps_eval_step(model, cfg: PSConfig, mesh: Mesh, preprocess=None):
     def worker_fn(params, batch_stats, images, labels):
         bs = tree_map(lambda a: a[0], batch_stats) if cfg.bn_mode == "local" else batch_stats
         x = preprocess(None, images) if preprocess else images.astype(jnp.float32)
-        logits, _ = apply_model(model, params, bs, x, train=False)
+        logits, _ = apply_model(model, tree_view(params), bs, x, train=False)
         loss = cross_entropy_loss(logits, labels)
         prec1, prec5 = accuracy(logits, labels, (1, 5))
         return lax.pmean({"loss": loss, "prec1": prec1, "prec5": prec5}, axis)

@@ -39,13 +39,13 @@ from .data import (
 from .models import build_model, input_shape_for, param_count
 from .optim import build_optimizer
 from .parallel import (
+    FlatVector,
     PSConfig,
     batch_sharding,
     init_ps_state,
     make_mesh,
     make_ps_eval_step,
     make_ps_train_step,
-    shard_batch,
     shard_state,
 )
 from .resilience import resolve_fault_plan
@@ -183,6 +183,9 @@ class Trainer:
             tcfg.lr,
             momentum=tcfg.momentum,
             weight_decay=tcfg.weight_decay,
+            # flat state (the default) takes the whole-vector update
+            # variants — same math, no per-leaf tree_map
+            flat=(pcfg.state_layout == "flat"),
         )
         shape = input_shape_for(tcfg.network)
         state = init_ps_state(
@@ -206,7 +209,15 @@ class Trainer:
         logger.info(
             "model %s (%d params), dataset %s%s, %d workers",
             tcfg.network,
-            param_count(state.params),
+            # flat layout: the true count is static metadata (the padded
+            # buffer would over-count by the alignment tail, and
+            # materializing the tree view just to count would waste a
+            # params-sized device allocation)
+            (
+                state.params.layout.total
+                if isinstance(state.params, FlatVector)
+                else param_count(state.params)
+            ),
             self.dataset.name,
             " [synthetic]" if self.dataset.synthetic else "",
             pcfg.num_workers,
@@ -762,7 +773,12 @@ class Trainer:
 
     # ---------------------------------------------------------------- validate
     def validate(self) -> dict:
-        """Full pass over the test split (parity: nn_ops.py:90-106)."""
+        """Full pass over the test split (parity: nn_ops.py:90-106).
+
+        Eval batches ride the same prefetch path as training: one batch
+        in flight, landing on the mesh PRE-SPLIT across workers
+        (batch_sharding) instead of single-device-then-redistribute —
+        the transfer of batch k+1 overlaps the eval step on batch k."""
         t = self.tcfg
         n = self.pcfg.num_workers
         bs = max(t.test_batch_size // n, 1) * n
@@ -772,9 +788,11 @@ class Trainer:
             bs,
             shuffle=False,
         )
+        prefetched = prefetch_to_device(
+            iter(it), size=2, device=batch_sharding(self.mesh, self.pcfg)
+        )
         out = average_metrics(
-            lambda b: self._eval_step(self.state, shard_batch(b, self.mesh, self.pcfg)),
-            it,
+            lambda b: self._eval_step(self.state, b), prefetched
         )
         if out:
             step_no = int(jax.device_get(self.state.step))

@@ -1,0 +1,429 @@
+"""Flat-state training engine (PSConfig.state_layout, parallel/buckets.
+FlatVector) acceptance suite.
+
+What going flat must (and must not) change, pinned:
+
+- ``compress=None`` flat-state training is BIT-EXACT vs tree-state at
+  both the collective level (aggregate_gradients flat_output moves no
+  values) and the step level; the int8/EF paths are bit-exact too (the
+  wire transform is shared, only the state container differs);
+- the fused whole-vector optimizer variants (optim.sgd_flat/adam_flat)
+  produce bit-identical updates to the per-leaf tree transforms;
+- checkpoints are TREE-SHAPED at the save/restore boundary: a
+  tree-layout checkpoint (byte-identical to the pre-flat-state format)
+  resumes bit-exact into a flat-layout run and vice versa, guard
+  counters and the EF residual included;
+- the non-finite guard's skip-step rollback works on flat state (the
+  jnp.where select covers the flat params/moment vectors);
+- the wire is LAYOUT-BLIND: for each contracts.layout_parity_pairs twin
+  the traced collective accounting is byte-identical and every PSC rule
+  stays clean;
+- the point of the exercise: ResNet18's update path (jaxpr ops
+  downstream of the gradient reduce) collapses >= 2x under flat state.
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ps_pytorch_tpu.models import build_model
+from ps_pytorch_tpu.optim import adam, adam_flat, sgd, sgd_flat
+from ps_pytorch_tpu.parallel import (
+    WORKER_AXIS,
+    FlatVector,
+    PSConfig,
+    aggregate_gradients,
+    init_ps_state,
+    make_ps_train_step,
+    shard_batch,
+    shard_state,
+    state_plan,
+    tree_view,
+)
+from ps_pytorch_tpu.parallel.buckets import (
+    pad_flat,
+    to_flat_vector,
+    tree_layout,
+    tree_to_flat,
+)
+
+N = 8
+
+tree_leaves = jax.tree_util.tree_leaves
+
+
+def _leaves_equal(a, b):
+    la, lb = tree_leaves(a), tree_leaves(b)
+    return len(la) == len(lb) and all(
+        np.array_equal(np.asarray(x), np.asarray(y)) for x, y in zip(la, lb)
+    )
+
+
+# -------------------------------------------------------- fused optimizers
+
+def _rand_tree(seed=0):
+    k = jax.random.key(seed)
+    return {
+        "w": jax.random.normal(jax.random.fold_in(k, 1), (13, 7)),
+        "b": jax.random.normal(jax.random.fold_in(k, 2), (7,)),
+        "nest": {"g": jax.random.normal(jax.random.fold_in(k, 3), (31,))},
+    }
+
+
+@pytest.mark.parametrize(
+    "make_pair",
+    [
+        lambda: (sgd(0.1), sgd_flat(0.1)),
+        lambda: (
+            sgd(0.05, momentum=0.9, weight_decay=1e-4, nesterov=True),
+            sgd_flat(0.05, momentum=0.9, weight_decay=1e-4, nesterov=True),
+        ),
+        lambda: (
+            sgd(0.05, momentum=0.9, dampening=0.5),
+            sgd_flat(0.05, momentum=0.9, dampening=0.5),
+        ),
+        lambda: (
+            adam(1e-2, weight_decay=1e-4),
+            adam_flat(1e-2, weight_decay=1e-4),
+        ),
+        lambda: (
+            adam(1e-2, amsgrad=True),
+            adam_flat(1e-2, amsgrad=True),
+        ),
+    ],
+    ids=["sgd", "sgd_nesterov_wd", "sgd_dampening", "adam_wd", "amsgrad"],
+)
+def test_flat_optimizers_bit_match_tree(make_pair):
+    """The whole-vector update variants are the SAME math: running the
+    tree transform per leaf and the flat transform on the concatenated
+    vector produces bit-identical parameters over several steps."""
+    tx_tree, tx_flat = make_pair()
+    params_t = _rand_tree(0)
+    plan = state_plan(PSConfig(num_workers=N), tree_layout(params_t).total)
+    params_f = to_flat_vector(params_t, plan)
+    opt_t, opt_f = tx_tree.init(params_t), tx_flat.init(params_f)
+    for step in range(4):
+        g_t = _rand_tree(step + 10)
+        g_f = params_f.replace(flat=pad_flat(tree_to_flat(g_t), plan))
+        u_t, opt_t = tx_tree.update(g_t, opt_t, params_t)
+        u_f, opt_f = tx_flat.update(g_f, opt_f, params_f)
+        params_t = jax.tree_util.tree_map(jnp.add, params_t, u_t)
+        params_f = jax.tree_util.tree_map(jnp.add, params_f, u_f)
+        assert _leaves_equal(params_t, tree_view(params_f)), step
+
+
+# ------------------------------------------------- collective-level parity
+
+def test_aggregate_flat_output_bit_exact(mesh):
+    """flat_output moves no values: concat-of-tree(agg) == flat(agg),
+    for the per-leaf wire, the fused bucket wire, and int8."""
+    def fn(v):
+        g = {
+            "a": (v[0] + 1.0) * jnp.linspace(-1.0, 1.0, 96),
+            "b": jnp.full((33,), v[0] * 0.5),
+        }
+        out = {}
+        for tag, kw in (
+            ("none_leaf", dict()),
+            ("none_fused", dict(bucket_bytes=0)),
+            ("int8", dict(compress="int8", quant_block_size=32,
+                          bucket_bytes=0)),
+        ):
+            t = aggregate_gradients(dict(g), WORKER_AXIS, N, **kw)
+            f = aggregate_gradients(
+                dict(g), WORKER_AXIS, N, flat_output=True, **kw
+            )
+            align = 32 if tag == "int8" else 1
+            plan = state_plan(
+                PSConfig(
+                    num_workers=N,
+                    compress=kw.get("compress"),
+                    quant_block_size=kw.get("quant_block_size", 0),
+                    bucket_bytes=kw.get("bucket_bytes"),
+                ),
+                tree_layout(g).total,
+            )
+            assert plan.align == align
+            out[tag] = (pad_flat(tree_to_flat(t), plan), f)
+        return out
+
+    vals = jnp.arange(N, dtype=jnp.float32).reshape(N, 1)
+    mapped = jax.shard_map(
+        fn, mesh=mesh, in_specs=(P(WORKER_AXIS),), out_specs=P(),
+        check_vma=False,
+    )
+    res = jax.device_get(mapped(vals))
+    for tag, (t, f) in res.items():
+        np.testing.assert_array_equal(t, f, err_msg=tag)
+
+
+# ------------------------------------------------------- step-level parity
+
+def _batch(n=16, seed=0):
+    rng = np.random.RandomState(seed)
+    return {
+        "image": rng.randint(0, 255, (n, 28, 28, 1)).astype(np.uint8),
+        "label": rng.randint(0, 10, (n,)).astype(np.int32),
+    }
+
+
+def _train(mesh, cfg, tx=None, steps=3, faults=None):
+    model = build_model("LeNet")
+    tx = tx or sgd(0.05, momentum=0.9)
+    state = init_ps_state(model, tx, cfg, jax.random.key(0), (28, 28, 1))
+    state = shard_state(state, mesh, cfg)
+    step = make_ps_train_step(model, tx, cfg, mesh, donate=False,
+                              faults=faults)
+    b = shard_batch(_batch(), mesh, cfg)
+    m = None
+    for i in range(steps):
+        state, m = step(state, b, jax.random.key(i))
+    return state, jax.device_get(m)
+
+
+@pytest.mark.parametrize(
+    "extra",
+    [
+        dict(),
+        dict(compress="int8", quant_block_size=64, error_feedback=True,
+             bucket_bytes=0),
+        dict(opt_placement="sharded", compress="int8", quant_block_size=64,
+             error_feedback=True),
+        # one config stacking the remaining flat-path variants: the
+        # 2-round scheme's PER-LEAF flat rebuild, random-free first_k
+        # masking, microbatch accumulation, and stochastic rounding keys
+        dict(compress="int8_2round", quant_block_size=32, num_aggregate=5,
+             mask_mode="first_k", grad_accum_steps=2,
+             quant_rounding="stochastic"),
+    ],
+    ids=["none_per_leaf", "int8_ef_fused", "zero1_int8_ef",
+         "2round_mask_accum_stochastic"],
+)
+def test_step_flat_bit_exact_vs_tree(mesh, extra):
+    """The flagship acceptance pin: the same config trained under both
+    state layouts produces bit-identical parameters, metrics, and (when
+    on) EF residuals — flat state is a container change, not a math
+    change. Covers the uncompressed per-leaf wire, the fused int8+EF
+    wire, the ZeRO-1 placement, and a stacked 2round/mask/accum/
+    stochastic config (the per-leaf flat rebuild path)."""
+    out = {}
+    for layout in ("tree", "flat"):
+        cfg = PSConfig(num_workers=N, state_layout=layout, **extra)
+        state, m = _train(mesh, cfg)
+        out[layout] = (
+            jax.device_get(tree_view(state.params)),
+            jax.device_get(state.comm_state),
+            m["loss"],
+        )
+    assert _leaves_equal(out["tree"][0], out["flat"][0])
+    assert _leaves_equal(out["tree"][1], out["flat"][1])
+    assert out["tree"][2] == out["flat"][2]
+
+
+def test_flat_state_structure(mesh):
+    """Under flat layout the live params/moments really ARE flat vectors
+    (one padded leaf each), and tree layout really is per-leaf."""
+    cfg = PSConfig(num_workers=N)
+    tx = sgd_flat(0.05, momentum=0.9)
+    state, _ = _train(mesh, cfg, tx=tx, steps=1)
+    assert isinstance(state.params, FlatVector)
+    assert isinstance(state.opt_state.momentum_buffer, FlatVector)
+    assert state.params.flat.ndim == 1
+    assert (
+        state.params.flat.shape[0]
+        == state.params.plan.padded_total
+        == state.opt_state.momentum_buffer.flat.shape[0]
+    )
+    n_tree_leaves = len(tree_leaves(tree_view(state.params)))
+    assert n_tree_leaves > 1  # LeNet: the view fans back out
+    assert len(tree_leaves(state.params)) == 1  # ...but the state doesn't
+
+
+# --------------------------------------------------- checkpoint portability
+
+def _ckpt_cfg(layout):
+    return PSConfig(
+        num_workers=N, state_layout=layout, compress="int8",
+        quant_block_size=64, error_feedback=True,
+    )
+
+
+def test_checkpoint_cross_layout_bit_exact(mesh, tmp_path):
+    """A tree-layout checkpoint (byte-identical to the pre-flat-state
+    on-disk format) resumes bit-exact into a flat-layout run and vice
+    versa — params, optimizer moments, guard counters, and the EF
+    residual all survive, and CONTINUED training from either restore is
+    bit-identical to the donor run."""
+    import ps_pytorch_tpu.checkpoint as ckpt
+
+    model = build_model("LeNet")
+    d = {"tree": str(tmp_path / "tree"), "flat": str(tmp_path / "flat")}
+    states, steps_fn = {}, {}
+    for layout in ("tree", "flat"):
+        cfg = _ckpt_cfg(layout)
+        tx = sgd(0.05, momentum=0.9)
+        s = shard_state(
+            init_ps_state(model, tx, cfg, jax.random.key(0), (28, 28, 1)),
+            mesh, cfg,
+        )
+        step = make_ps_train_step(model, tx, cfg, mesh, donate=False)
+        b = shard_batch(_batch(), mesh, cfg)
+        for i in range(2):
+            s, _ = step(s, b, jax.random.key(i))
+        ckpt.save_checkpoint(jax.device_get(s), d[layout], 2)
+        states[layout], steps_fn[layout] = s, step
+    for src, dst in (("tree", "flat"), ("flat", "tree")):
+        cfg = _ckpt_cfg(dst)
+        target = jax.device_get(
+            init_ps_state(
+                model, sgd(0.05, momentum=0.9), cfg, jax.random.key(7),
+                (28, 28, 1),
+            )
+        )
+        restored = ckpt.load_checkpoint(target, d[src], 2)
+        # bit-exact restore across layouts (tree views compare the math)
+        assert _leaves_equal(
+            tree_view(restored.params), tree_view(states[src].params)
+        ), (src, dst)
+        assert _leaves_equal(restored.comm_state, states[src].comm_state)
+        assert _leaves_equal(restored.guard_state, states[src].guard_state)
+        assert int(restored.step) == 2
+        # continuation parity: two more steps in the DST layout match
+        # two more steps of the SRC donor bit-for-bit
+        cont = shard_state(restored, mesh, cfg)
+        donor = states[src]
+        b = shard_batch(_batch(), mesh, cfg)
+        for i in range(2, 4):
+            cont, _ = steps_fn[dst](cont, b, jax.random.key(i))
+            donor, _ = steps_fn[src](donor, b, jax.random.key(i))
+        assert _leaves_equal(
+            tree_view(cont.params), tree_view(donor.params)
+        ), (src, dst)
+
+
+def test_flatvector_state_dict_is_tree_shaped():
+    """The serialization edge itself: a FlatVector's state dict is the
+    nested per-leaf dict (NOT a raw buffer), so the on-disk format is
+    layout-blind."""
+    from flax import serialization
+
+    tree = _rand_tree(3)
+    plan = state_plan(PSConfig(num_workers=N), tree_layout(tree).total)
+    fv = to_flat_vector(tree, plan)
+    sd = serialization.to_state_dict(fv)
+    assert set(sd) == {"w", "b", "nest"}
+    assert _leaves_equal(sd, tree)
+    back = serialization.from_state_dict(
+        to_flat_vector(jax.tree_util.tree_map(jnp.zeros_like, tree), plan),
+        sd,
+    )
+    np.testing.assert_array_equal(
+        np.asarray(back.flat), np.asarray(fv.flat)
+    )
+
+
+# ------------------------------------------------------- guard on flat state
+
+def test_guard_skip_rolls_back_flat_state(mesh):
+    """A NaN-poisoned step on flat state is the identity update: the
+    flat params/moment vectors keep their pre-step bits, the skip
+    counter advances, and the run continues."""
+    from ps_pytorch_tpu.resilience import FaultPlan
+
+    cfg = PSConfig(num_workers=N, state_layout="flat")
+    tx = sgd_flat(0.05, momentum=0.9)
+    model = build_model("LeNet")
+    state = shard_state(
+        init_ps_state(model, tx, cfg, jax.random.key(0), (28, 28, 1)),
+        mesh, cfg,
+    )
+    step = make_ps_train_step(
+        model, tx, cfg, mesh, donate=False,
+        faults=FaultPlan(nan_grads=(2,)),
+    )
+    b = shard_batch(_batch(), mesh, cfg)
+    state1, _ = step(state, b, jax.random.key(0))
+    before = jax.device_get(state1)
+    state2, m2 = step(state1, b, jax.random.key(1))  # poisoned step
+    after = jax.device_get(state2)
+    assert float(m2["skipped_steps"]) == 1.0
+    np.testing.assert_array_equal(
+        np.asarray(before.params.flat), np.asarray(after.params.flat)
+    )
+    np.testing.assert_array_equal(
+        np.asarray(before.opt_state.momentum_buffer.flat),
+        np.asarray(after.opt_state.momentum_buffer.flat),
+    )
+    state3, m3 = step(state2, b, jax.random.key(2))  # healthy again
+    assert float(m3["skipped_steps"]) == 1.0
+    assert float(m3["skip_streak"]) == 0.0
+    assert not np.array_equal(
+        np.asarray(after.params.flat),
+        np.asarray(jax.device_get(state3.params.flat)),
+    )
+
+
+# ------------------------------------------------------ wire is layout-blind
+
+def test_wire_accounting_identical_across_layouts():
+    """pscheck layout-parity gate: for each (flat, tree) twin the traced
+    collective accounting — kind, axes, dtype, count, bytes — is
+    byte-identical, and every PSC rule stays clean. State layout is
+    compute-side only; going flat moves ZERO bytes on the wire."""
+    from ps_pytorch_tpu.check.contracts import layout_parity_pairs
+    from ps_pytorch_tpu.check.core import run_checks, trace_spec
+
+    for flat_spec, tree_spec in layout_parity_pairs():
+        rf, rt = trace_spec(flat_spec), trace_spec(tree_spec)
+        assert rf.summary == rt.summary, flat_spec.name
+        findings = run_checks([rf, rt], contract=None)
+        assert findings == [], (flat_spec.name, findings)
+
+
+# -------------------------------------------------- the update-path collapse
+
+@pytest.mark.parametrize("config_kw", [
+    dict(compress="int8", placement="replicated", network="ResNet18"),
+])
+def test_resnet18_update_path_collapses(config_kw):
+    """Acceptance pin: ResNet18's update path — jaxpr ops downstream of
+    the gradient reduce (the per-leaf scatter + per-leaf optimizer +
+    per-leaf apply chain) — shrinks >= 2x under state_layout='flat'.
+    Trace-only: nothing compiles or executes."""
+    from ps_pytorch_tpu.check.contracts import RESNET_BUCKET_BYTES, _ps_spec
+    from ps_pytorch_tpu.check.opcount import update_path_op_count
+
+    counts = {}
+    for layout in ("tree", "flat"):
+        spec = _ps_spec(
+            state_layout=layout, bucket_bytes=RESNET_BUCKET_BYTES,
+            **config_kw,
+        )
+        built = spec.build()
+        counts[layout] = update_path_op_count(built.step, *built.args)
+    assert counts["flat"] > 0
+    assert counts["tree"] >= 2 * counts["flat"], counts
+
+
+# ----------------------------------------------------------------- CLI flag
+
+def test_state_layout_cli_flag_mapping():
+    import argparse
+
+    from ps_pytorch_tpu.cli._flags import add_ps_flags, ps_config_from
+
+    parser = argparse.ArgumentParser()
+    add_ps_flags(parser)
+    for argv, want in (
+        ([], "flat"),
+        (["--state-layout", "tree"], "tree"),
+        (["--state-layout", "flat"], "flat"),
+    ):
+        args = parser.parse_args(argv)
+        assert ps_config_from(args, 8).state_layout == want
+    with pytest.raises(SystemExit):
+        parser.parse_args(["--state-layout", "diagonal"])
+    with pytest.raises(ValueError):
+        PSConfig(num_workers=4, state_layout="diagonal")

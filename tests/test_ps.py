@@ -31,6 +31,7 @@ from ps_pytorch_tpu.parallel import (
     make_ps_train_step,
     shard_batch,
     shard_state,
+    tree_view,
 )
 
 N = 8
@@ -60,8 +61,10 @@ def test_dp_step_matches_single_device(mesh):
     model, tx, state, step = _lenet_setup(cfg, mesh)
     batch = _batch(16)
     sharded = shard_batch(batch, mesh, cfg)
-    # snapshot params BEFORE the step: the step donates its input state
-    params0 = jax.device_get(state.params)
+    # snapshot params BEFORE the step: the step donates its input state.
+    # tree_view: the default flat state layout stores params as one flat
+    # vector; the single-device reference math below needs the pytree
+    params0 = jax.device_get(tree_view(state.params))
     new_state, metrics = step(state, sharded, jax.random.key(1))
     x = jnp.asarray(batch["image"], jnp.float32)
     y = jnp.asarray(batch["label"])
@@ -84,7 +87,7 @@ def test_dp_step_matches_single_device(mesh):
     opt_state = tx.init(params0)
     updates, _ = tx.update(grads, opt_state, params0)
     expected = optax.apply_updates(params0, updates)
-    got = jax.device_get(new_state.params)
+    got = jax.device_get(tree_view(new_state.params))
     for a, b in zip(jax.tree_util.tree_leaves(expected), jax.tree_util.tree_leaves(got)):
         np.testing.assert_allclose(np.asarray(a), np.asarray(b), rtol=2e-4, atol=2e-6)
     assert float(metrics["loss"]) > 0
@@ -145,7 +148,9 @@ def test_sharded_matches_replicated(mesh):
         model, tx, state, step = _lenet_setup(cfg, mesh, momentum=0.9)
         for i, b in enumerate(batches):
             state, metrics = step(state, shard_batch(b, mesh, cfg), jax.random.key(9))
-        results[placement] = jax.device_get(state.params)
+        # tree views: the two placements pad their flat buffers to
+        # different alignments, so the raw vectors are not comparable
+        results[placement] = jax.device_get(tree_view(state.params))
     for a, b in zip(
         jax.tree_util.tree_leaves(results["replicated"]),
         jax.tree_util.tree_leaves(results["sharded"]),

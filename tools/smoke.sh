@@ -2,10 +2,11 @@
 # One-shot smoke of the full product surface on a virtual 8-device CPU mesh
 # (no TPU needed). Exercises: both static-analysis gates (pslint source
 # gate, pscheck jaxpr contract gate), the multi-chip dryrun (all
-# parallelism axes), the PS CNN trainer + evaluator, the LM trainer on tp
-# with vocab-parallel embedding + the LM evaluator with KV-cache sampling,
-# and the headline benchmark in its trimmed form. Budget ~5 minutes of CPU
-# (compiles dominate).
+# parallelism axes), the PS CNN trainer + evaluator, the flat-state
+# default (int8 + EF + guard NaN-inject), the LM trainer on tp with
+# vocab-parallel embedding + the LM evaluator with KV-cache sampling,
+# and the headline benchmark in its trimmed form. Budget ~6 minutes of
+# CPU (compiles dominate).
 #
 #   bash tools/smoke.sh
 set -euo pipefail
@@ -53,6 +54,18 @@ run python -m ps_pytorch_tpu.cli.train \
     --train-dir "$TMP/chaos"
 test -f "$TMP/chaos/model_step_6.corrupt" \
     || { echo "chaos smoke: corrupt checkpoint was not quarantined"; exit 1; }
+
+# flat-state leg (ARCHITECTURE §6f, the default --state-layout): int8
+# wire + error feedback + a NaN gradient at step 3 — the guard must
+# skip-step by rolling back the FLAT params/moment vectors, and training
+# must continue to a clean finish on the 8-device CPU mesh
+run python -m ps_pytorch_tpu.cli.train \
+    --network LeNet --dataset MNIST --num-workers 8 --batch-size 64 \
+    --max-steps 6 --eval-freq 3 --log-interval 1 \
+    --state-layout flat --compress-grad compress --quant-block-size 32 \
+    --error-feedback --bucket-bytes 65536 \
+    --fault-plan '{"nan_grads":[3]}' \
+    --train-dir "$TMP/flat"
 
 run python -m ps_pytorch_tpu.cli.train_lm \
     --parallelism tp --heads 8 --dim 64 --vocab-size 64 --shard-vocab \
