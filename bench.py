@@ -54,6 +54,10 @@ WORKLOADS = {
     # serving side of the same transformer: KV-cache autoregressive
     # generation (models/decode.py), tokens/sec of NEW tokens
     "decode": dict(metric=None),
+    # the serving ENGINE under open-loop traffic: continuous-batching
+    # slot pool + scheduler (ps_pytorch_tpu/serve), tokens/sec of
+    # completed tokens plus p50/p99 per-token latency
+    "serve": dict(metric=None),
 }
 
 
@@ -116,21 +120,50 @@ _CNN_DTYPE_DEFAULT = "float32"
 _DEC_DEFAULTS = {"BATCH": 8, "PROMPT": 128, "NEW": 128, "DIM": 512,
                  "DEPTH": 6}
 
+# the serve leg's own knobs; model shape comes from the SAME BENCH_DEC_*
+# envs as the decode leg (serving measures the same model, open-loop)
+_SRV_DEFAULTS = {"SLOTS": 8, "REQS": 32}
+_SRV_RATE_DEFAULT = 100.0
+
 
 def _dec_env(name: str) -> int:
     return int(os.environ.get(f"BENCH_DEC_{name}", _DEC_DEFAULTS[name]))
 
 
-def _dec_tag() -> str:
-    """Decode metric shape tag from the SAME BENCH_DEC_* envs the workload
-    reads (error records share the key — same contract as _lm_tag)."""
+def _srv_env(name: str) -> int:
+    return int(os.environ.get(f"BENCH_SRV_{name}", _SRV_DEFAULTS[name]))
+
+
+def _srv_rate() -> float:
+    """Arrival rate is a FLOAT everywhere traffic is modeled (TrafficConfig
+    .rate_rps, cli/serve --rate) — sub-1 rps open-loop regimes are real."""
+    return float(os.environ.get("BENCH_SRV_RATE", _SRV_RATE_DEFAULT))
+
+
+def _dec_shape_tag(extra: str) -> str:
+    """THE decode-family metric-shape helper: model shape from the SAME
+    BENCH_DEC_* envs both the decode and serve workloads read, plus the
+    leg's own ``extra`` knob segment; error records share the key (same
+    contract as _lm_tag). One parser, two legs — the tags cannot drift."""
     tag = (
         f"d{_dec_env('DIM')}x{_dec_env('DEPTH')}"
-        f"_p{_dec_env('PROMPT')}_n{_dec_env('NEW')}_b{_dec_env('BATCH')}"
+        f"_p{_dec_env('PROMPT')}_n{_dec_env('NEW')}{extra}"
     )
     if os.environ.get("BENCH_DTYPE", _LM_DTYPE_DEFAULT) == "float32":
         tag += "_f32"
     return tag
+
+
+def _dec_tag() -> str:
+    return _dec_shape_tag(f"_b{_dec_env('BATCH')}")
+
+
+def _srv_tag() -> str:
+    # %g renders integral rates without a trailing .0 ("r100", "r0.5")
+    extra = f"_s{_srv_env('SLOTS')}_r{_srv_rate():g}"
+    if os.environ.get("BENCH_SRV_INT8KV") == "1":
+        extra += "_q8kv"
+    return _dec_shape_tag(extra)
 
 
 def _bench_decode(steps: int) -> tuple:
@@ -190,6 +223,93 @@ def _bench_decode(steps: int) -> tuple:
     host_sync(out, prompt)
     elapsed = time.perf_counter() - t0
     return batch * n_new * steps / elapsed, elapsed, hlo_ops
+
+
+def _bench_serve() -> tuple:
+    """Open-loop serving throughput/latency: the continuous-batching
+    engine (ps_pytorch_tpu/serve) under the seeded Poisson traffic
+    generator — tokens/sec of completed new tokens plus p50/p99
+    per-token latency. Mixed request shapes (prompt lengths in
+    [PROMPT/2, PROMPT], budgets in [NEW/2, NEW]) exercise admission,
+    eviction, and slot reuse; the compile warmup runs outside the
+    measured window (the decode bench excludes compile the same way)."""
+    import jax
+    import jax.numpy as jnp
+
+    from ps_pytorch_tpu.models.transformer import (
+        TransformerConfig,
+        init_transformer,
+    )
+    from ps_pytorch_tpu.serve import (
+        ServeConfig,
+        ServingEngine,
+        TrafficConfig,
+        make_requests,
+        run_open_loop,
+    )
+
+    _, dt = _bench_dtype(jnp, _LM_DTYPE_DEFAULT)
+    t_prompt, n_new = _dec_env("PROMPT"), _dec_env("NEW")
+    cfg = TransformerConfig(
+        vocab_size=2048,
+        dim=_dec_env("DIM"),
+        depth=_dec_env("DEPTH"),
+        heads=8,
+        max_seq_len=t_prompt + n_new,
+        compute_dtype=dt,
+    )
+    params = init_transformer(cfg, jax.random.key(0))
+    serve = ServeConfig(
+        slots=_srv_env("SLOTS"),
+        max_len=t_prompt + n_new,
+        max_prompt_len=t_prompt,
+        kv_int8=os.environ.get("BENCH_SRV_INT8KV") == "1",
+    )
+    engine = ServingEngine(cfg, params, serve)
+    engine.warmup()
+    try:
+        from ps_pytorch_tpu.check.opcount import hlo_op_count
+
+        hlo_ops = hlo_op_count(engine.compiled_decode_text())
+    except Exception:
+        hlo_ops = None
+    tc = TrafficConfig(
+        n_requests=_srv_env("REQS"),
+        rate_rps=_srv_rate(),
+        prompt_len_min=max(1, t_prompt // 2),
+        prompt_len_max=t_prompt,
+        new_tokens_min=max(1, n_new // 2),
+        new_tokens_max=n_new,
+        vocab_size=cfg.vocab_size,
+        seed=0,
+    )
+    summary = run_open_loop(engine, make_requests(tc))
+    return summary, hlo_ops
+
+
+def _serve_contract_entry():
+    """The committed serve accounting row for the MEASURED KV config
+    (serve_decode / serve_decode_int8kv) — pinned ZERO collectives/bytes
+    (PSC107); attached to the record so the serving wire's silence is
+    evidence, not assumption."""
+    name = (
+        "serve_decode_int8kv"
+        if os.environ.get("BENCH_SRV_INT8KV") == "1"
+        else "serve_decode"
+    )
+    here = os.path.dirname(os.path.abspath(__file__))
+    try:
+        with open(os.path.join(here, "runs", "comm_contract.json")) as f:
+            data = json.load(f)
+        entry = data["configs"][name]
+    except (OSError, ValueError, KeyError):
+        return None
+    return {
+        "config": name,
+        "n_collectives": entry["n_collectives"],
+        "wire_bytes": entry["total_bytes"],
+        "mesh_devices": data.get("mesh_devices"),
+    }
 
 
 def _bench_dtype(jnp, default: str):
@@ -531,10 +651,11 @@ def _validate_env() -> None:
                 f"BENCH_COMPRESS must be one of {list(_COMPRESS_VALUES)}, "
                 f"got {os.environ['BENCH_COMPRESS']!r}"
             )
-        if os.environ.get("BENCH_WORKLOAD", "lenet") in ("lm", "decode"):
+        if os.environ.get("BENCH_WORKLOAD", "lenet") in ("lm", "decode",
+                                                         "serve"):
             raise SystemExit(
                 "BENCH_COMPRESS only applies to the CNN (PS) workloads; "
-                "it would be silently ignored for lm/decode"
+                "it would be silently ignored for lm/decode/serve"
             )
     # AB=0 is the documented "off" value — as inert as unset, so a CI
     # wrapper exporting it globally must not abort the lm/decode legs
@@ -546,10 +667,10 @@ def _validate_env() -> None:
             val = None
         if val is not None and os.environ.get(
             "BENCH_WORKLOAD", "lenet"
-        ) in ("lm", "decode"):
+        ) in ("lm", "decode", "serve"):
             raise SystemExit(
                 f"{knob} only applies to the CNN (PS) workloads; "
-                "it would be silently ignored for lm/decode"
+                "it would be silently ignored for lm/decode/serve"
             )
     if (os.environ.get("BENCH_AB_BUCKETING") == "1"
             and os.environ.get("BENCH_AB_STATE_LAYOUT") == "1"):
@@ -584,6 +705,7 @@ def _validate_env() -> None:
         ["BENCH_STEPS", "BENCH_CHAIN"]
         + [f"BENCH_LM_{k}" for k in _LM_DEFAULTS]
         + [f"BENCH_DEC_{k}" for k in _DEC_DEFAULTS]
+        + [f"BENCH_SRV_{k}" for k in _SRV_DEFAULTS]
     )
     for knob in int_knobs:
         val = os.environ.get(knob)
@@ -592,6 +714,24 @@ def _validate_env() -> None:
                 int(val)
             except ValueError:
                 raise SystemExit(f"{knob} must be an integer, got {val!r}")
+    for knob in ("BENCH_SRV_SLOTS", "BENCH_SRV_REQS"):
+        if os.environ.get(knob) is not None and int(os.environ[knob]) < 1:
+            raise SystemExit(f"{knob} must be >= 1")
+    if os.environ.get("BENCH_SRV_RATE") is not None:
+        try:
+            rate = float(os.environ["BENCH_SRV_RATE"])
+        except ValueError:
+            raise SystemExit(
+                f"BENCH_SRV_RATE must be a number > 0, "
+                f"got {os.environ['BENCH_SRV_RATE']!r}"
+            )
+        if not (rate > 0 and np.isfinite(rate)):
+            raise SystemExit("BENCH_SRV_RATE must be a finite number > 0")
+    if os.environ.get("BENCH_SRV_INT8KV") not in (None, "0", "1"):
+        raise SystemExit(
+            f"BENCH_SRV_INT8KV must be 0 or 1, "
+            f"got {os.environ['BENCH_SRV_INT8KV']!r}"
+        )
 
 
 def _success_metric() -> str:
@@ -603,6 +743,8 @@ def _success_metric() -> str:
         return f"lm_{_lm_tag()}_train_tokens_per_sec"
     if name == "decode":
         return f"decode_{_dec_tag()}_new_tokens_per_sec"
+    if name == "serve":
+        return f"serve_{_srv_tag()}_tokens_per_sec"
     metric = WORKLOADS.get(name, {}).get("metric") or f"{name}_train_throughput"
     _, ctag = _cnn_compress(WORKLOADS.get(name, {}).get("compress"))
     return metric + ctag + _bucket_tag() + _layout_tag() + _cnn_dtype_suffix()
@@ -720,6 +862,38 @@ def main() -> None:
         print(json.dumps(rec))
         print(
             f"# 1 device, {elapsed:.2f}s for {steps} generate calls",
+            file=sys.stderr,
+        )
+        return
+    if name == "serve":
+        summary, srv_hlo_ops = _bench_serve()
+        rec = {
+            "metric": _success_metric() + suffix,
+            "value": summary["tokens_per_sec"],
+            "unit": "tokens/sec",
+            "vs_baseline": None,  # no serving counterpart in the reference
+            "mfu": None,  # open-loop serving is latency-bound by design
+            "device": device_kind,
+            "timestamp": _utc_now(),
+            "hlo_op_count": srv_hlo_ops,
+            # the serving wire is PINNED silent (PSC107) — attach the
+            # committed zero-collective row as evidence
+            "comm": _serve_contract_entry(),
+            "serving": {
+                k: summary[k]
+                for k in (
+                    "requests_completed", "new_tokens", "elapsed_s",
+                    "p50_token_latency_s", "p99_token_latency_s",
+                    "p50_ttft_s", "p99_ttft_s",
+                )
+            },
+        }
+        if fallback:
+            _attach_banked(rec)
+        print(json.dumps(rec))
+        print(
+            f"# 1 device, {summary['elapsed_s']:.2f}s for "
+            f"{summary['requests_completed']} open-loop requests",
             file=sys.stderr,
         )
         return

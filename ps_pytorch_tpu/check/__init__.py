@@ -7,9 +7,10 @@ verify the communication contracts ARCHITECTURE §1-§6b claim — every
 axis carries its collective (PSC101), gradient reductions feed the
 optimizer (PSC102), compressed wires stay int8 (PSC103), per-collective
 wire bytes round-trip against runs/comm_contract.json (PSC104),
-donation survives lowering (PSC105), and bucketed wires stay fused —
-no more gradient-path collectives than the declared bucket plan allows
-(PSC106).
+donation survives lowering (PSC105), bucketed wires stay fused — no
+more gradient-path collectives than the declared bucket plan allows
+(PSC106) — and the serving hot path stays collective-free with an
+honest KV storage dtype (PSC107).
 
 Entry points: ``python -m ps_pytorch_tpu.check``, ``tools/check.sh``,
 and the tier-1 gate in tests/test_check.py.
@@ -21,6 +22,7 @@ from .contracts import (
     DonationSpec,
     FusionSpec,
     GradReduce,
+    ServePolicy,
     WireAllowance,
     WirePolicy,
     get_contracts,
@@ -48,6 +50,7 @@ __all__ = [
     "FusionSpec",
     "GradReduce",
     "RULE_IDS",
+    "ServePolicy",
     "TraceResult",
     "WireAllowance",
     "WirePolicy",

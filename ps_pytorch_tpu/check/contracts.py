@@ -113,6 +113,24 @@ class FusionSpec:
         return self.per_bucket * self.n_buckets + self.slack
 
 
+@dataclasses.dataclass(frozen=True)
+class ServePolicy:
+    """PSC107: the serving hot path's contract (serve/engine.py).
+
+    A serving decode step moves NO training bytes: any collective in its
+    jaxpr is a regression (the step is slot-parallel by construction —
+    weights replicated, pool sharded over slots). The KV pool arg at
+    ``kv_argnum`` must also honor the declared storage dtype policy:
+    ``quantized`` pools carry int8 payload leaves (``*_q``) with f32
+    block-scale rows (``*_s``); unquantized pools carry ``kv_dtype``
+    K/V — an f32 leaf sneaking into a declared-int8 pool is the serving
+    analogue of PSC103's wire-dtype regression."""
+
+    kv_argnum: int = 1
+    quantized: bool = False
+    kv_dtype: str = "float32"
+
+
 @dataclasses.dataclass
 class Built:
     """What a spec's builder returns: the real jitted step plus abstract
@@ -132,6 +150,7 @@ class ContractSpec:
     wire: Optional[WirePolicy] = None
     donation: Optional[DonationSpec] = None
     fusion: Optional[FusionSpec] = None
+    serve: Optional[ServePolicy] = None
 
 
 # metrics / loss pmean: a handful of f32 scalars, every scheme emits it
@@ -495,6 +514,63 @@ def _dp_tp_pp_spec() -> ContractSpec:
     )
 
 
+def _serve_spec(int8_kv: bool) -> ContractSpec:
+    """The serving hot path's contract: the REAL compiled decode step
+    (serve/engine.make_decode_step — the same factory the engine jits),
+    traced over abstract pool/weights. Zero collectives, donated KV
+    pool, declared storage dtype (PSC105 + PSC107)."""
+
+    def build() -> Built:
+        import jax
+        import jax.numpy as jnp
+
+        from ..models.transformer import init_transformer
+        from ..parallel.buckets import FlatVector, plan_buckets, tree_layout
+        from ..serve.engine import ServeConfig, make_decode_step
+        from ..serve.kv import init_kv_pool
+
+        cfg = _lm_cfg()
+        serve = ServeConfig(
+            slots=MESH_DEVICES, max_len=16, max_prompt_len=8,
+            kv_int8=int8_kv,
+        )
+        params_tree = jax.eval_shape(
+            lambda: init_transformer(cfg, jax.random.key(0))
+        )
+        layout = tree_layout(params_tree)
+        plan = plan_buckets(layout.total, 0, align=1)
+        params = FlatVector(
+            flat=jax.ShapeDtypeStruct((plan.padded_total,), jnp.float32),
+            layout=layout, plan=plan,
+        )
+        pool = jax.eval_shape(
+            lambda: init_kv_pool(cfg, serve.slots, serve.max_len,
+                                 int8=serve.kv_int8)
+        )
+        step = jax.jit(make_decode_step(cfg, serve), donate_argnums=(1,))
+        s = serve.slots
+        return Built(
+            step=step,
+            args=(
+                params,
+                pool,
+                jax.ShapeDtypeStruct((s,), jnp.int32),
+                jax.ShapeDtypeStruct((s,), jnp.int32),
+                jax.ShapeDtypeStruct((s,), jnp.bool_),
+            ),
+            # the pool is the state that persists across ticks
+            select_params=lambda out: out[0],
+        )
+
+    return ContractSpec(
+        name="serve_decode" + ("_int8kv" if int8_kv else ""),
+        build=build,
+        axes=(),  # slot-parallel: NO mesh axis may be consumed
+        donation=DonationSpec(argnums=(1,), out_positions=(0,)),
+        serve=ServePolicy(kv_argnum=1, quantized=int8_kv),
+    )
+
+
 # the flagship bucketed config's bucket size (4 MiB): ResNet18's
 # ~44.7 MB f32 gradient payload -> 11 buckets instead of 62 per-leaf
 # collectives. MiB-scale buckets amortize collective latency without
@@ -561,4 +637,7 @@ def get_contracts() -> Tuple[ContractSpec, ...]:
     specs.extend(
         [_dp_tp_spec(), _pp_spec(), _moe_spec(), _dp_tp_pp_spec()]
     )
+    # the serving hot path (ARCHITECTURE §7e): the compiled decode step
+    # must stay collective-free with a donated, dtype-honest KV pool
+    specs.extend([_serve_spec(False), _serve_spec(True)])
     return tuple(specs)

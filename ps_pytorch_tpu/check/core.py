@@ -46,6 +46,11 @@ class TraceResult:
     donated_leaves: int               # leaves of the declared donated args
     donation_mismatches: List[str]    # in/out aval mismatches (would drop
                                       # aliasing on the pod)
+    kv_leaves: List[Tuple[str, str]] = dataclasses.field(
+        default_factory=list
+    )                                 # (path, dtype) of the KV pool arg,
+                                      # collected when spec.serve is set
+                                      # (PSC107 storage-dtype policy)
 
 
 def _tree_leaves_with_none(tree):
@@ -106,6 +111,15 @@ def trace_spec(spec: ContractSpec) -> TraceResult:
     param_idx = [i for i, leaf in enumerate(flat_out) if id(leaf) in sel_ids]
     colls = collect_collectives(closed, param_out_indices=param_idx)
     marks, donated, mismatches = _donation_info(built, spec)
+    kv_leaves: List[Tuple[str, str]] = []
+    if spec.serve is not None:
+        flat_kv = jax.tree_util.tree_flatten_with_path(
+            built.args[spec.serve.kv_argnum]
+        )[0]
+        kv_leaves = [
+            (jax.tree_util.keystr(path), str(leaf.dtype))
+            for path, leaf in flat_kv
+        ]
     return TraceResult(
         spec=spec,
         collectives=colls,
@@ -113,6 +127,7 @@ def trace_spec(spec: ContractSpec) -> TraceResult:
         donor_marks=marks,
         donated_leaves=donated,
         donation_mismatches=mismatches,
+        kv_leaves=kv_leaves,
     )
 
 

@@ -24,6 +24,12 @@
 |        | bucket_bytes) + slack reduce-kind collectives feeding the       |
 |        | updated params — a refactor quietly going back to one           |
 |        | collective per pytree leaf fails the gate                       |
+| PSC107 | serving hot-path regressions: a step declaring a ServePolicy    |
+|        | (the slot-parallel decode step, serve/engine.py) must emit ZERO |
+|        | collectives, and its KV pool must honor the declared storage    |
+|        | dtype (int8 payload + f32 block scales when quantized; the      |
+|        | compute dtype otherwise) — an f32 leaf in a declared-int8 pool  |
+|        | is the serving analogue of a PSC103 wire regression             |
 """
 
 from __future__ import annotations
@@ -33,7 +39,8 @@ from typing import Dict, List, Sequence
 from .core import CheckFinding, TraceResult
 from .walker import REDUCE_KINDS
 
-RULE_IDS = ("PSC101", "PSC102", "PSC103", "PSC104", "PSC105", "PSC106")
+RULE_IDS = ("PSC101", "PSC102", "PSC103", "PSC104", "PSC105", "PSC106",
+            "PSC107")
 
 
 def psc101_axes(r: TraceResult) -> List[CheckFinding]:
@@ -142,6 +149,52 @@ def psc106_fusion(r: TraceResult) -> List[CheckFinding]:
     )]
 
 
+def psc107_serve(r: TraceResult) -> List[CheckFinding]:
+    """The serving hot path: zero collectives + KV storage dtype policy.
+
+    Collectives are checked at the jaxpr level (named-axis ops): the
+    decode step is slot-parallel by construction — weights replicated,
+    pool sharded over slots — so ANY collective means training-style
+    communication crept into the request loop. The dtype policy walks
+    the KV pool arg's leaves by path: ``*_q`` payload / ``*_s`` scale
+    rows for a quantized pool, plain K/V in the declared compute dtype
+    otherwise."""
+    sp = r.spec.serve
+    if sp is None:
+        return []
+    out = []
+    for c in r.collectives:
+        out.append(CheckFinding(
+            "PSC107", r.spec.name,
+            f"{c.kind} over {list(c.axes)} [{c.dtype}, {c.bytes} B] on "
+            f"the serving hot path — the decode step is slot-parallel "
+            f"and must emit zero collectives",
+        ))
+    for path, dtype in r.kv_leaves:
+        if sp.quantized:
+            if path.endswith("_q']"):
+                want = "int8"
+            elif path.endswith("_s']"):
+                want = "float32"
+            else:
+                out.append(CheckFinding(
+                    "PSC107", r.spec.name,
+                    f"KV pool leaf {path} [{dtype}] on a declared int8 "
+                    f"pool is neither payload (*_q) nor scale row (*_s) "
+                    f"— unquantized storage crept in",
+                ))
+                continue
+        else:
+            want = sp.kv_dtype
+        if dtype != want:
+            out.append(CheckFinding(
+                "PSC107", r.spec.name,
+                f"KV pool leaf {path} carries {dtype}, declared storage "
+                f"dtype is {want} — serving cache dtype regression",
+            ))
+    return out
+
+
 def psc105_donation(r: TraceResult) -> List[CheckFinding]:
     if r.spec.donation is None:
         return []
@@ -165,6 +218,7 @@ def check_result(r: TraceResult) -> List[CheckFinding]:
         + psc103_wire(r)
         + psc105_donation(r)
         + psc106_fusion(r)
+        + psc107_serve(r)
     )
 
 

@@ -412,6 +412,45 @@ def latest_valid_step(model_dir: str) -> Optional[int]:
     return None
 
 
+def listify_raw(tree):
+    """msgpack restores list-typed pytree nodes as dicts {'0': ...}; undo.
+
+    Shared by every raw-dict consumer (cli/evaluate_lm, serve/engine):
+    the LM checkpoint's params carry a ``blocks`` LIST, and a consumer
+    rebuilding model structure from the raw dict needs it back."""
+    if isinstance(tree, dict):
+        if tree and all(k.isdigit() for k in tree):
+            return [listify_raw(tree[str(i)]) for i in range(len(tree))]
+        return {k: listify_raw(v) for k, v in tree.items()}
+    return tree
+
+
+def load_latest_valid(model_dir: str, after_step: Optional[int] = None):
+    """Read-only fast path for a polling consumer (the serving engine's
+    hot-rollover poll): newest checkpoint strictly newer than
+    ``after_step`` (None = any), loaded as raw nested dicts in ONE read.
+
+    ``latest_valid_step`` + ``load_checkpoint_raw`` pay two reads per
+    file (verify, then load — inherent to checking before yielding a
+    step to an arbitrary consumer). Here the consumer is this function's
+    own caller, so the CRC check and the decode run on the SAME in-memory
+    bytes: one read per candidate, corrupt/unreadable files are skipped
+    (never touched — the writer may still be racing us), and the result
+    is ``(step, raw_dict)`` or None when nothing newer loads."""
+    for step in reversed(available_steps(model_dir)):
+        if after_step is not None and step <= after_step:
+            return None
+        try:
+            data, _ = _read_payload(model_dir, step, read_attempts=1)
+            return step, _decode_payload(data, checkpoint_path(model_dir, step))
+        except (CheckpointCorruptError, OSError) as e:
+            logger.warning(
+                "checkpoint step %d is not loadable (%s); trying older",
+                step, e,
+            )
+    return None
+
+
 def load_checkpoint(target, model_dir: str, step: int):
     """Load step N into the structure of `target` (an initialized state).
     Auto-detects codec-compressed checkpoints.

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..checkpoint import load_checkpoint_raw, poll_checkpoints
+from ..checkpoint import listify_raw, load_checkpoint_raw, poll_checkpoints
 from ..ops.metrics import next_token_nll
 from ..utils import get_logger
 
@@ -33,13 +33,9 @@ logger = get_logger()
 EVAL_SEQUENCE_SEED_OFFSET = 7919  # prime shift: held-out walks, same chain
 
 
-def _listify(tree):
-    """msgpack restores list-typed pytree nodes as dicts {'0': ..}; undo."""
-    if isinstance(tree, dict):
-        if tree and all(k.isdigit() for k in tree):
-            return [_listify(tree[str(i)]) for i in range(len(tree))]
-        return {k: _listify(v) for k, v in tree.items()}
-    return tree
+# raw-dict list restoration lives at the checkpoint boundary now
+# (checkpoint.listify_raw) — the serving engine consumes it too
+_listify = listify_raw
 
 
 @functools.lru_cache(maxsize=8)

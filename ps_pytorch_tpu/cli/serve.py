@@ -1,0 +1,166 @@
+"""Serving entry — continuous-batching decode of a trained LM checkpoint
+under synthetic open-loop traffic, with hot checkpoint rollover.
+
+The serving counterpart of cli/evaluate_lm.py: consumes the same
+scheme-agnostic checkpoints cli/train_lm.py writes (dense LMs), loads
+them into the slot-pool engine (serve/engine.py — FlatVector weights,
+one compiled prefill + one compiled decode step), and drives it with a
+seeded Poisson arrival schedule whose prompts are held-out walks of the
+SAME Markov chain the model was trained on. With ``--poll-interval`` the
+engine polls the checkpoint directory mid-serve and hot-swaps to newer
+weights under the drain-then-swap rule (in-flight requests finish on the
+weights that started them).
+
+Prints exactly ONE JSON summary line (tokens/sec, p50/p99 per-token
+latency, rollovers) — the same record shape the bench serve leg emits.
+
+  JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
+      python -m ps_pytorch_tpu.cli.serve --model-dir /tmp/lm \\
+      --requests 32 --rate 50 --poll-interval 0.5
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+
+from ..checkpoint import load_checkpoint_raw, load_latest_valid
+from ..serve import ServeConfig, ServingEngine, TrafficConfig
+from ..serve.engine import checkpoint_model
+from ..serve.traffic import make_requests, run_open_loop
+from ..utils import get_logger
+
+logger = get_logger()
+
+# prime shift (distinct from evaluate_lm's 7919): served prompts are
+# held-out walks of the training chain, and not the eval split either
+SERVE_SEQUENCE_SEED_OFFSET = 104729
+
+
+def main(argv=None) -> dict:
+    p = argparse.ArgumentParser("ps_pytorch_tpu.cli.serve")
+    p.add_argument("--model-dir", required=True)
+    p.add_argument("--step", type=int, default=None,
+                   help="serve this checkpoint step (default: newest valid)")
+    p.add_argument("--slots", type=int, default=8,
+                   help="KV-cache slots (concurrent sequences)")
+    p.add_argument("--max-len", type=int, default=0,
+                   help="cache positions per slot (0 = model max_seq_len)")
+    p.add_argument("--max-prompt-len", type=int, default=0,
+                   help="static prefill width (0 = --prompt-max)")
+    p.add_argument("--int8-kv", action="store_true",
+                   help="store the KV pool as int8 + per-(position, head) "
+                        "block scales (4x cache memory; serve/kv.py)")
+    p.add_argument("--num-workers", type=int, default=0,
+                   help="shard the slot pool over an N-device mesh "
+                        "(0 = single device)")
+    p.add_argument("--dtype", default="float32",
+                   choices=["float32", "bfloat16"],
+                   help="compute dtype for the decode matmuls (weights "
+                        "stay f32 in the flat buffer)")
+    p.add_argument("--requests", type=int, default=32)
+    p.add_argument("--rate", type=float, default=100.0,
+                   help="open-loop Poisson arrival rate (requests/sec)")
+    p.add_argument("--prompt-min", type=int, default=4)
+    p.add_argument("--prompt-max", type=int, default=16)
+    p.add_argument("--new-min", type=int, default=8)
+    p.add_argument("--new-max", type=int, default=32)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--poll-interval", type=float, default=0.0,
+                   help="poll for newer checkpoints every N seconds and "
+                        "hot-roll onto them (0 = serve one step forever)")
+    p.add_argument("--no-warmup", action="store_true",
+                   help="skip the pre-traffic compile warmup (latency "
+                        "percentiles then include XLA compilation)")
+    p.add_argument("--summary-file", type=str, default=None,
+                   help="also write the JSON summary here")
+    args = p.parse_args(argv)
+
+    import jax.numpy as jnp
+
+    cd = jnp.bfloat16 if args.dtype == "bfloat16" else None
+    if args.step is None:
+        found = load_latest_valid(args.model_dir)
+        if found is None:
+            raise FileNotFoundError(f"no valid checkpoints in {args.model_dir}")
+        step, raw = found
+    else:
+        step, raw = args.step, load_checkpoint_raw(args.model_dir, args.step)
+    cfg, params = checkpoint_model(raw, cd)
+
+    max_prompt = args.max_prompt_len or args.prompt_max
+    max_len = args.max_len or cfg.max_seq_len
+    # fail fast on traffic/pool geometry mismatches BEFORE the engine
+    # compiles: a bad combination would otherwise crash mid-serve at the
+    # first oversized arrival and lose the already-served work
+    if args.prompt_max > max_prompt:
+        raise SystemExit(
+            f"--prompt-max {args.prompt_max} exceeds the prefill width "
+            f"--max-prompt-len {max_prompt}"
+        )
+    if args.prompt_max + args.new_max > max_len:
+        raise SystemExit(
+            f"--prompt-max {args.prompt_max} + --new-max {args.new_max} "
+            f"exceeds the slot length (--max-len {max_len})"
+        )
+    serve_cfg = ServeConfig(
+        slots=args.slots,
+        max_len=max_len,
+        max_prompt_len=max_prompt,
+        kv_int8=args.int8_kv,
+    )
+    mesh = None
+    if args.num_workers:
+        from ..parallel.mesh import make_mesh
+
+        mesh = make_mesh(num_workers=args.num_workers)
+    engine = ServingEngine(
+        cfg, params, serve_cfg, mesh=mesh,
+        model_dir=args.model_dir, step=step,
+    )
+    logger.info(
+        "serving step %d: %d slots x %d positions%s%s",
+        step, serve_cfg.slots, serve_cfg.max_len,
+        " (int8 KV)" if args.int8_kv else "",
+        f" over {args.num_workers} workers" if mesh is not None else "",
+    )
+
+    # prompts: held-out walks of the model's own training chain, so the
+    # served completions exercise the learned distribution
+    from .train_lm import make_synthetic_tokens
+
+    data_seed = int(raw["data"]["seed"])
+    corpus = make_synthetic_tokens(
+        cfg.vocab_size, args.requests, max(args.prompt_max, 2),
+        seed=data_seed,
+        sequence_seed=data_seed + SERVE_SEQUENCE_SEED_OFFSET + args.seed,
+    )
+    rows = iter(range(args.requests))
+    tc = TrafficConfig(
+        n_requests=args.requests,
+        rate_rps=args.rate,
+        prompt_len_min=args.prompt_min,
+        prompt_len_max=args.prompt_max,
+        new_tokens_min=args.new_min,
+        new_tokens_max=args.new_max,
+        vocab_size=cfg.vocab_size,
+        seed=args.seed,
+    )
+    requests = make_requests(
+        tc, prompt_source=lambda rng, ln: corpus[next(rows), :ln]
+    )
+    if not args.no_warmup:
+        engine.warmup()
+    summary = run_open_loop(
+        engine, requests, poll_interval_s=args.poll_interval
+    )
+    line = json.dumps(summary, sort_keys=True)
+    print(line)
+    if args.summary_file:
+        with open(args.summary_file, "w", encoding="utf-8") as f:
+            f.write(line + "\n")
+    return summary
+
+
+if __name__ == "__main__":
+    main()

@@ -412,19 +412,40 @@ class HostSyncRule:
     pull device values to the host every step: `.item()`, `float(metrics)`,
     `np.asarray(device)`, and `jax.device_get` all block dispatch and
     serialize the pipeline. Periodic, intentional transfers carry a
-    ``# psl: sync-ok`` pragma. Scope: modules named in HOT_MODULES."""
+    ``# psl: sync-ok`` pragma. Scope: modules named in HOT_MODULES —
+    the training driver AND the serving request loop (serve/engine.py),
+    where a stray per-token fetch beyond the scheduler's one fused
+    [slots] read would serialize every decode tick."""
 
     rule_id = "PSL004"
 
-    HOT_MODULES = {"trainer.py"}
+    # entries with a "/" match as path suffixes (pinning the rule to THE
+    # serve engine, not any future module that happens to be named
+    # engine.py); bare names match by basename
+    HOT_MODULES = {"trainer.py", "serve/engine.py"}
     STEP_CALL_RE = re.compile(r"(^|[._])(train_|eval_)?step(_fn)?$")
+    # a per-step entry point (the serving engine's tick()) IS a loop
+    # body by contract — its caller invokes it once per decode step —
+    # so its top level is scanned at loop depth 1 even though the
+    # enclosing `while` lives in another function
+    HOT_FN_RE = re.compile(r"^_?tick\w*$")
 
     _COMPOUND = (ast.For, ast.AsyncFor, ast.While, ast.If, ast.With,
                  ast.AsyncWith, ast.Try)
 
+    def _is_hot(self, path: str) -> bool:
+        norm = path.replace(os.sep, "/")
+        for mod in self.HOT_MODULES:
+            if "/" in mod:
+                if norm == mod or norm.endswith("/" + mod):
+                    return True
+            elif os.path.basename(path) == mod:
+                return True
+        return False
+
     def check(self, tree: ast.AST, path: str, axes: Dict[str, str],
               donors: Dict[str, Tuple[int, ...]]) -> Iterable[Finding3]:
-        if os.path.basename(path) not in self.HOT_MODULES:
+        if not self._is_hot(path):
             return
         for node in ast.walk(tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -432,8 +453,10 @@ class HostSyncRule:
                 # periodic `metrics = jax.device_get(metrics)` inside a
                 # log window untaints only from that point on — per-step
                 # syncs on the same name BEFORE the fetch still flag
+                depth0 = 1 if self.HOT_FN_RE.match(node.name) else 0
                 yield from self._scan_block(
-                    node.body, tainted=set(), loop_depth=0, flagged=set()
+                    node.body, tainted=set(), loop_depth=depth0,
+                    flagged=set()
                 )
 
     def _flag_stmt(

@@ -45,7 +45,10 @@ def _attend_cached(q, k_cache, v_cache, length, scale):
     """q [B, 1, H, hd] against cache[:, :L]; positions >= length masked.
 
     length is a traced scalar (the number of valid cache slots, including
-    the position q is at)."""
+    the position q is at) or an int [B] vector of per-row lengths — the
+    serving engine's continuous-batching pool (serve/engine.py) holds one
+    independent sequence per row, each at its own position, while the
+    single-request decode below passes the shared scalar pos + 1."""
     # f32 scores/softmax regardless of compute dtype — the same softmax-
     # statistics convention as full/ring/flash attention in training, so
     # bf16 decode cannot numerically diverge from the training forward.
@@ -56,6 +59,8 @@ def _attend_cached(q, k_cache, v_cache, length, scale):
         * scale
     )  # [B,H,1,L] f32
     pos = jnp.arange(k_cache.shape[1])
+    # scalar length broadcasts to [1,1,1,1]; a [B] vector to [B,1,1,1]
+    length = jnp.reshape(jnp.asarray(length), (-1, 1, 1, 1))
     scores = jnp.where(pos[None, None, None, :] < length, scores, NEG_INF)
     p = jax.nn.softmax(scores, axis=-1)
     out = jnp.einsum(

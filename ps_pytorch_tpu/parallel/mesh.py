@@ -126,6 +126,16 @@ def worker_stacked_sharding(mesh: Mesh, axis_name: str = WORKER_AXIS) -> NamedSh
     return NamedSharding(mesh, P(axis_name))
 
 
+def pool_sharding(mesh: Mesh, dim: int = 1,
+                  axis_name: str = WORKER_AXIS) -> NamedSharding:
+    """Sharding that splits dimension ``dim`` of a pooled buffer over the
+    worker axis. The serving engine's KV pool is [depth, slots, ...] —
+    slots (dim 1) shard across the mesh while depth stays whole, so every
+    worker owns a contiguous band of request slots and the decode step is
+    embarrassingly slot-parallel (zero collectives, see serve/engine.py)."""
+    return NamedSharding(mesh, P(*([None] * dim), axis_name))
+
+
 def initialize_multihost(
     coordinator_address: Optional[str] = None,
     num_processes: Optional[int] = None,

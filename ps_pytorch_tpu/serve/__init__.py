@@ -1,0 +1,36 @@
+"""Serving subsystem: continuous-batching decode on the mesh with hot
+checkpoint rollover (ARCHITECTURE §7e).
+
+- ``engine``: the slot-pool decode engine (one compiled prefill + one
+  compiled decode step, FlatVector weights, drain-then-swap rollover);
+- ``scheduler``: host-side admit/evict slot bookkeeping;
+- ``kv``: the pooled KV cache (compute-dtype or int8 block-scale);
+- ``traffic``: seeded open-loop traffic + the latency summary.
+
+Entry point: ``python -m ps_pytorch_tpu.cli.serve``.
+"""
+
+from .engine import (
+    ServeConfig,
+    ServingEngine,
+    make_decode_step,
+    make_prefill_step,
+)
+from .kv import init_kv_pool
+from .scheduler import Completion, Request, SlotScheduler
+from .traffic import TrafficConfig, make_requests, run_open_loop, summarize
+
+__all__ = [
+    "Completion",
+    "Request",
+    "ServeConfig",
+    "ServingEngine",
+    "SlotScheduler",
+    "TrafficConfig",
+    "init_kv_pool",
+    "make_decode_step",
+    "make_prefill_step",
+    "make_requests",
+    "run_open_loop",
+    "summarize",
+]

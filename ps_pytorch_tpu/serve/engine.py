@@ -1,0 +1,435 @@
+"""Continuous-batching serving engine with hot checkpoint rollover.
+
+The third role in the reference deployment — the evaluator that polls a
+shared checkpoint directory and runs inference out-of-band — grown into
+a serving loop (ROADMAP item 3): a fixed pool of KV-cache slots stepped
+by ONE compiled decode program, requests admitted and evicted per step
+by the host-side scheduler (serve/scheduler.py), weights hot-swapped
+mid-serve when the trainer lands a new checkpoint.
+
+Static shapes everywhere, exactly two compiled programs:
+
+- ``prefill``: one slot's padded prompt ([max_prompt_len] int32; the
+  pad tail's K/V is causally downstream of the real prompt only, never
+  attended — decode overwrites each position before its first read)
+  through the batched causal forward, K/V captured per block and written
+  into the slot with ``lax.dynamic_update_slice``;
+- ``decode``: every slot advances one token — per-slot positions,
+  per-slot length masks (models/decode._attend_cached generalized to a
+  length VECTOR), scatter writes at each slot's own position, greedy
+  argmax. Finished/empty slots ride along masked (their writes land in
+  regions the next occupant overwrites before attending), so admit/
+  evict never recompiles.
+
+Weights: the checkpoint's param tree lives on device as ONE padded flat
+f32 vector in the flat-state engine's own layout
+(parallel/buckets.FlatVector, the same geometry the trainer trains in),
+so a checkpoint rollover is a single flat-buffer swap — the compiled
+steps see an identical aval and never retrace. Rollover semantics are
+PINNED as drain-then-swap: when a newer valid checkpoint appears
+(checkpoint.load_latest_valid — the read-only single-read fast path),
+admission pauses, in-flight sequences FINISH ON THE WEIGHTS THAT
+STARTED THEM, then the buffer swaps and admission resumes. A completion
+therefore always carries exactly one ``weights_step``, never a mix.
+
+On a mesh the pool shards over the slot axis (parallel/mesh.
+pool_sharding) with weights replicated: the decode step is
+embarrassingly slot-parallel — ZERO collectives, a property the
+``serve_decode`` pscheck contract (PSC107) pins at the jaxpr level.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import time
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..checkpoint import listify_raw, load_checkpoint_raw, load_latest_valid
+from ..models.transformer import (
+    TransformerConfig,
+    _rms_norm,
+    select_attention,
+    transformer_block,
+)
+from ..parallel.buckets import (
+    FlatVector,
+    _np_tree_to_flat,
+    plan_buckets,
+    tree_layout,
+    tree_view,
+)
+from ..parallel.mesh import pool_sharding, replicated_sharding
+from ..utils import get_logger
+from .kv import attend_pool, init_kv_pool, write_slot, write_token
+from .scheduler import Completion, Request, SlotScheduler
+
+logger = get_logger()
+
+
+@dataclasses.dataclass(frozen=True)
+class ServeConfig:
+    """Pool geometry + storage policy for one serving engine."""
+
+    slots: int = 8
+    max_len: int = 256           # cache positions per slot
+    max_prompt_len: int = 64     # static prefill width (pad target)
+    kv_int8: bool = False        # int8 K/V payload + block scales
+    donate: bool = True          # donate the pool through both steps
+
+
+def make_prefill_step(cfg: TransformerConfig, serve: ServeConfig):
+    """(params, pool, prompt [max_prompt_len], slot) -> pool.
+
+    The same block math as models/decode.prefill (transformer_block +
+    the config's within-chip attention), targeted at one pool slot."""
+
+    def prefill(params_any, pool, prompt, slot):
+        params = tree_view(params_any)
+        cd = cfg.effective_compute_dtype
+        t = prompt.shape[0]
+        pos = jnp.arange(t)
+        x = (params["embed"][prompt] + params["pos_embed"][pos]).astype(cd)
+        x = x[None]  # [1, T, D]
+        base_attend = select_attention(cfg, None)
+
+        for i, blk in enumerate(params["blocks"]):
+
+            def attend(q, k, v, _i=i):
+                nonlocal pool
+                pool = write_slot(pool, _i, slot, k[0], v[0])
+                return base_attend(q, k, v)
+
+            x = transformer_block(cfg, x, blk, attend)
+        return pool
+
+    return prefill
+
+
+def make_decode_step(cfg: TransformerConfig, serve: ServeConfig):
+    """(params, pool, tok [S], pos [S], active [S])
+    -> (pool, next [S], next_pos [S]).
+
+    One greedy token for every slot at once. Inactive slots hold their
+    token and position (the argmax is masked away) and their cache write
+    is benign: the position they scribble is re-written by the slot's
+    next occupant before it is ever attended. next/next_pos are returned
+    so steady-state ticks can thread them straight back in as the next
+    step's device inputs — zero host->device transfers between
+    admissions/evictions (see ServingEngine.tick)."""
+
+    def step(params_any, pool, tok, pos, active):
+        params = tree_view(params_any)
+        cd = cfg.effective_compute_dtype
+        x = (params["embed"][tok] + params["pos_embed"][pos]).astype(cd)
+        x = x[:, None]  # [S, 1, D]
+        scale = 1.0 / (cfg.head_dim ** 0.5)
+        lengths = pos + 1
+
+        for i, blk in enumerate(params["blocks"]):
+
+            def attend(q, k, v, _i=i):
+                nonlocal pool
+                pool = write_token(pool, _i, pos, k[:, 0], v[:, 0])
+                return attend_pool(pool, _i, q, lengths, scale)
+
+            x = transformer_block(cfg, x, blk, attend)
+
+        xf = _rms_norm(x[:, 0].astype(cd), params["out_norm"].astype(cd))
+        logits = (xf @ params["embed"].T.astype(cd)).astype(jnp.float32)
+        nxt = jnp.argmax(logits, axis=-1).astype(jnp.int32)
+        nxt = jnp.where(active, nxt, tok)
+        return pool, nxt, pos + active.astype(jnp.int32)
+
+    return step
+
+
+def _flat_params(layout, plan, tree) -> np.ndarray:
+    """Host-side pack of a param tree into the engine's flat geometry."""
+    return _np_tree_to_flat(layout, plan, tree)
+
+
+class ServingEngine:
+    """One model, one slot pool, one request loop.
+
+    Greedy decode only (the serving contract is determinism: the same
+    request set replays to the same tokens regardless of batching —
+    pinned by tests/test_serve.py against per-sequence models/decode)."""
+
+    def __init__(
+        self,
+        cfg: TransformerConfig,
+        params: Dict,
+        serve: ServeConfig,
+        mesh=None,
+        model_dir: Optional[str] = None,
+        step: Optional[int] = None,
+        clock=None,
+    ):
+        if not cfg.causal:
+            raise ValueError("serving decode is autoregressive: cfg.causal")
+        if serve.max_len > cfg.max_seq_len:
+            raise ValueError(
+                f"serve.max_len {serve.max_len} exceeds the model's "
+                f"positional range {cfg.max_seq_len}"
+            )
+        if mesh is not None and serve.slots % mesh.devices.size:
+            raise ValueError(
+                f"slots ({serve.slots}) must divide over the mesh "
+                f"({mesh.devices.size} devices) for slot sharding"
+            )
+        self.cfg = cfg
+        self.serve = serve
+        self.mesh = mesh
+        self.model_dir = model_dir
+        self.step = step
+        # the latency clock: read at admission and again after each
+        # token fetch. The open-loop driver (serve/traffic.py) rebases it
+        # so arrival times and emission times share one timeline; tests
+        # inject a virtual clock for determinism.
+        self.clock = clock or time.perf_counter
+        self.scheduler = SlotScheduler(
+            serve.slots, serve.max_len, serve.max_prompt_len
+        )
+
+        # weights: ONE padded flat f32 vector in the flat-state layout
+        # (single bucket — the rollover swap is one buffer either way)
+        self._layout = tree_layout(params)
+        self._plan = plan_buckets(self._layout.total, 0, align=1)
+        flat = _flat_params(self._layout, self._plan, params)
+        self._params = FlatVector(
+            flat=self._place_flat(flat), layout=self._layout, plan=self._plan
+        )
+
+        pool = init_kv_pool(cfg, serve.slots, serve.max_len, int8=serve.kv_int8)
+        if mesh is not None:
+            sh = pool_sharding(mesh, dim=1)
+            pool = {k: jax.device_put(v, sh) for k, v in pool.items()}
+        self._pool = pool
+
+        donate = (1,) if serve.donate else ()
+        self._prefill = jax.jit(
+            make_prefill_step(cfg, serve), donate_argnums=donate
+        )
+        self._decode = jax.jit(
+            make_decode_step(cfg, serve), donate_argnums=donate
+        )
+
+        s = serve.slots
+        self._tok = np.zeros((s,), np.int32)
+        self._pos = np.zeros((s,), np.int32)
+        self._active = np.zeros((s,), bool)
+        # device-side (tok, pos, active) triple: rebuilt from the host
+        # arrays only on ticks AFTER an admission/eviction (dirty);
+        # otherwise the previous step's own outputs thread straight back
+        # in — steady-state ticks pay zero host->device transfers
+        self._dev: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None
+        self._dirty = True
+        self._pending: Optional[Tuple[int, np.ndarray]] = None
+        self.rollovers: List[Dict[str, Any]] = []
+
+    # ------------------------------------------------------- construction
+    @classmethod
+    def from_checkpoint(
+        cls,
+        model_dir: str,
+        serve: ServeConfig,
+        step: Optional[int] = None,
+        mesh=None,
+        compute_dtype=None,
+    ) -> "ServingEngine":
+        """Load a cli/train_lm checkpoint (dense LMs; the evaluator's
+        scheme-agnostic raw layout) into a serving engine."""
+        if step is None:
+            found = load_latest_valid(model_dir)
+            if found is None:
+                raise FileNotFoundError(f"no valid checkpoints in {model_dir}")
+            step, raw = found
+        else:
+            raw = load_checkpoint_raw(model_dir, step)
+        cfg, params = checkpoint_model(raw, compute_dtype)
+        return cls(cfg, params, serve, mesh=mesh, model_dir=model_dir,
+                   step=step)
+
+    def _place_flat(self, flat: np.ndarray) -> jax.Array:
+        if self.mesh is not None:
+            return jax.device_put(flat, replicated_sharding(self.mesh))
+        return jnp.asarray(flat)
+
+    # ---------------------------------------------------------- rollover
+    def poll_rollover(self) -> Optional[int]:
+        """Stage the newest valid checkpoint newer than the serving step
+        (single-read validate+load). Returns the staged step, or None.
+        The swap itself waits for the drain — see tick()."""
+        if self.model_dir is None:
+            return None
+        # while a rollover is already staged, only a STRICTLY newer step
+        # re-stages — repeated polls during a drain stay one cheap listdir
+        after = self._pending[0] if self._pending is not None else self.step
+        found = load_latest_valid(self.model_dir, after_step=after)
+        if found is None:
+            return None
+        new_step, raw = found
+        cfg, params = checkpoint_model(raw, self.cfg.compute_dtype)
+        layout = tree_layout(params)
+        if layout.shapes != self._layout.shapes:
+            raise ValueError(
+                f"checkpoint step {new_step} has a different param "
+                f"geometry than the serving model — rollover would "
+                f"require a recompile, refusing"
+            )
+        self._pending = (
+            new_step, _flat_params(self._layout, self._plan, params)
+        )
+        logger.info(
+            "rollover staged: step %s -> %d (draining %d in-flight)",
+            self.step, new_step, self.scheduler.n_inflight,
+        )
+        return new_step
+
+    def _swap_pending(self, now_s: float) -> None:
+        new_step, flat = self._pending
+        self._pending = None
+        self._params = FlatVector(
+            flat=self._place_flat(flat),
+            layout=self._layout,
+            plan=self._plan,
+        )
+        self.rollovers.append(
+            {"from_step": self.step, "to_step": new_step, "at_s": now_s}
+        )
+        logger.info("rollover complete: now serving step %d", new_step)
+        self.step = new_step
+
+    @property
+    def draining(self) -> bool:
+        return self._pending is not None
+
+    # ------------------------------------------------------------ intake
+    def submit(self, request: Request) -> None:
+        self.scheduler.submit(request)
+
+    # -------------------------------------------------------------- loop
+    def tick(self) -> List[Completion]:
+        """One scheduler round: swap-if-drained, admit, one decode step,
+        record/evict. Returns the completions that finished this tick."""
+        now_s = self.clock()
+        if self._pending is not None and self.scheduler.n_inflight == 0:
+            self._swap_pending(now_s)
+        if self._pending is None:
+            for slot, req in self.scheduler.admit(now_s):
+                self._admit_slot(slot, req)
+        if self.scheduler.n_inflight == 0:
+            return []
+
+        if self._dirty or self._dev is None:
+            self._dev = (
+                jnp.asarray(self._tok), jnp.asarray(self._pos),
+                jnp.asarray(self._active),
+            )
+            self._dirty = False
+        tok_d, pos_d, act_d = self._dev
+        self._pool, nxt, new_pos = self._decode(
+            self._params, self._pool, tok_d, pos_d, act_d
+        )
+        self._dev = (nxt, new_pos, act_d)
+        # THE per-tick host sync: the scheduler cannot admit/evict
+        # without this step's tokens — one fused [slots] fetch, not a
+        # per-request read
+        tokens = np.asarray(jax.device_get(nxt))  # psl: sync-ok
+        # latency is measured at emission (after the fetch retires), not
+        # at tick entry — the fetch IS the serving latency's device half
+        emit_s = self.clock()
+
+        done: List[Completion] = []
+        for slot in list(self.scheduler.active_slots):
+            token = int(tokens[slot])
+            self._tok[slot] = token
+            self._pos[slot] += 1
+            if self.scheduler.record_token(slot, token, emit_s):
+                self._active[slot] = False
+                self._dirty = True  # next tick rebuilds the device triple
+                done.append(
+                    self.scheduler.evict(slot, emit_s, weights_step=self.step)
+                )
+        return done
+
+    def _admit_slot(self, slot: int, req: Request) -> None:
+        plen = int(req.prompt.shape[0])
+        if plen > 1:
+            padded = np.zeros((self.serve.max_prompt_len,), np.int32)
+            padded[:plen] = req.prompt
+            self._pool = self._prefill(
+                self._params, self._pool, jnp.asarray(padded),
+                np.int32(slot),
+            )
+        self._tok[slot] = int(req.prompt[plen - 1])
+        self._pos[slot] = plen - 1
+        self._active[slot] = True
+        self._dirty = True  # next tick rebuilds the device triple
+
+    # ------------------------------------------------------- conveniences
+    def compiled_decode_text(self) -> str:
+        """Optimized-HLO text of the decode step (bench op-count probe).
+        Lowered over the live avals — tracing only, nothing executes and
+        no pool buffer is donated by a .lower()."""
+        s = self.serve.slots
+        return self._decode.lower(
+            self._params, self._pool,
+            jax.ShapeDtypeStruct((s,), jnp.int32),
+            jax.ShapeDtypeStruct((s,), jnp.int32),
+            jax.ShapeDtypeStruct((s,), jnp.bool_),
+        ).compile().as_text()
+
+    def warmup(self) -> None:
+        """Compile both steps (one throwaway request through prefill +
+        decode) so served latency measures the engine, not XLA. The pool
+        slot it dirties is freed and overwritten on first real use."""
+        plen = min(2, self.serve.max_prompt_len)
+        self.submit(Request(
+            rid=-1, prompt=np.zeros((plen,), np.int32), max_new_tokens=1
+        ))
+        while not self.scheduler.idle:
+            self.tick()
+
+    def decode_requests(self, requests: Sequence[Request],
+                        poll_every: int = 0) -> List[Completion]:
+        """Closed-loop drive: submit everything, tick to idle. With
+        ``poll_every`` > 0, poll for a checkpoint rollover every that
+        many ticks (tests use this to pin the drain semantics)."""
+        for r in requests:
+            self.submit(r)
+        out: List[Completion] = []
+        ticks = 0
+        while not self.scheduler.idle or self._pending is not None:
+            out.extend(self.tick())
+            ticks += 1
+            if poll_every and ticks % poll_every == 0:
+                self.poll_rollover()
+        return sorted(out, key=lambda c: c.rid)
+
+
+def checkpoint_model(raw: dict, compute_dtype) -> Tuple[TransformerConfig, Dict]:
+    """Rebuild (TransformerConfig, params tree) from a train_lm raw
+    checkpoint dict. Dense models only — MoE decode needs the roomy-
+    capacity expert mixture and is not in the serving engine yet."""
+    m = raw["model"]
+    if m.get("kind", "dense") != "dense":
+        raise ValueError(
+            "the serving engine decodes dense LM checkpoints only "
+            f"(checkpoint kind: {m.get('kind')!r})"
+        )
+    cfg = TransformerConfig(
+        vocab_size=int(m["vocab_size"]),
+        dim=int(m["dim"]),
+        depth=int(m["depth"]),
+        heads=int(m["heads"]),
+        mlp_ratio=int(m["mlp_ratio"]),
+        max_seq_len=int(m["max_seq_len"]),
+        compute_dtype=compute_dtype,
+    )
+    params = jax.tree.map(np.asarray, listify_raw(raw["params"]))
+    return cfg, params

@@ -1,0 +1,147 @@
+"""Slot-pooled KV cache for the continuous-batching serving engine.
+
+The training-side decode path (models/decode.py) holds one cache per
+`generate()` call — every sequence in the batch shares a position. The
+serving pool generalizes that to a FIXED pool of request slots: one
+[depth, slots, max_len, heads, head_dim] buffer pair, each slot an
+independent sequence at its own position, admitted and evicted without
+recompilation (static shapes; per-slot length masks do the rest).
+
+Two storage formats, selected by ``ServeConfig.kv_int8``:
+
+- compute-dtype (f32/bf16) K/V, attended by the SAME ``_attend_cached``
+  the single-request decoder uses (per-slot length vector) — the
+  token-exactness oracle path;
+- int8 K/V with per-(position, head) block scales, reusing the wire's
+  block-scale quantizer (ops/quantize.quantize_int8, block = head_dim:
+  one symmetric absmax scale per head vector, so a slot write never
+  straddles a quantization block and per-position scatter writes stay
+  local). Attention keeps the int8 payload in the einsum operands and
+  applies the scales to the f32 score/probability rows instead of
+  materializing a dequantized pool — the memory win is the point.
+
+Write paths are static-shape: a whole-slot ``lax.dynamic_update_slice``
+at admission (prefill) and a per-slot scatter (`.at[depth, slot, pos]`)
+inside the decode step.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from ..models.decode import NEG_INF, _attend_cached
+from ..models.transformer import TransformerConfig
+from ..ops.quantize import quantize_int8
+
+
+def init_kv_pool(cfg: TransformerConfig, slots: int, max_len: int,
+                 int8: bool = False) -> Dict:
+    """Zeroed slot pool. Compute-dtype buffers, or int8 payloads plus
+    f32 per-(position, head) scale rows when ``int8``."""
+    shape = (cfg.depth, slots, max_len, cfg.heads, cfg.head_dim)
+    if not int8:
+        cd = cfg.effective_compute_dtype
+        return {"k": jnp.zeros(shape, cd), "v": jnp.zeros(shape, cd)}
+    sshape = (cfg.depth, slots, max_len, cfg.heads, 1)
+    return {
+        "k_q": jnp.zeros(shape, jnp.int8),
+        "k_s": jnp.zeros(sshape, jnp.float32),
+        "v_q": jnp.zeros(shape, jnp.int8),
+        "v_s": jnp.zeros(sshape, jnp.float32),
+    }
+
+
+def pool_is_int8(pool: Dict) -> bool:
+    return "k_q" in pool
+
+
+def _quant_rows(x: jax.Array) -> Tuple[jax.Array, jax.Array]:
+    """Quantize [..., H, hd] to int8 with one scale per head vector.
+
+    The wire's block-scale quantizer flattens to [n_blocks, block] rows;
+    block = head_dim divides the flattened size exactly, so no padding
+    and no block ever straddles a (position, head) boundary — the same
+    carving-invariance the bucketed gradient wire relies on."""
+    hd = x.shape[-1]
+    q, s = quantize_int8(x.astype(jnp.float32), block_size=hd)
+    return q.reshape(x.shape), s.reshape(x.shape[:-1] + (1,))
+
+
+def write_slot(pool: Dict, block: int, slot: jax.Array,
+               k: jax.Array, v: jax.Array) -> Dict:
+    """Admission write: this block's full-prompt K/V [T, H, hd] into slot
+    positions [0, T) — one dynamic_update_slice per buffer (slot is a
+    traced scalar, T is static)."""
+    pool = dict(pool)
+    if not pool_is_int8(pool):
+        for name, val in (("k", k), ("v", v)):
+            buf = pool[name]
+            pool[name] = lax.dynamic_update_slice(
+                buf, val.astype(buf.dtype)[None, None], (block, slot, 0, 0, 0)
+            )
+        return pool
+    for name, val in (("k", k), ("v", v)):
+        q, s = _quant_rows(val)
+        pool[name + "_q"] = lax.dynamic_update_slice(
+            pool[name + "_q"], q[None, None], (block, slot, 0, 0, 0)
+        )
+        pool[name + "_s"] = lax.dynamic_update_slice(
+            pool[name + "_s"], s[None, None], (block, slot, 0, 0, 0)
+        )
+    return pool
+
+
+def write_token(pool: Dict, block: int, pos: jax.Array,
+                k: jax.Array, v: jax.Array) -> Dict:
+    """Decode-step write: one token's K/V [S, H, hd] at each slot's OWN
+    position (``pos`` int [S]) — a scatter, because unlike the
+    single-request cache there is no shared position to slice at."""
+    pool = dict(pool)
+    sl = jnp.arange(k.shape[0])
+    if not pool_is_int8(pool):
+        for name, val in (("k", k), ("v", v)):
+            buf = pool[name]
+            pool[name] = buf.at[block, sl, pos].set(val.astype(buf.dtype))
+        return pool
+    for name, val in (("k", k), ("v", v)):
+        q, s = _quant_rows(val)
+        pool[name + "_q"] = pool[name + "_q"].at[block, sl, pos].set(q)
+        pool[name + "_s"] = pool[name + "_s"].at[block, sl, pos].set(s)
+    return pool
+
+
+def attend_pool(pool: Dict, block: int, q: jax.Array, lengths: jax.Array,
+                scale: float) -> jax.Array:
+    """q [S, 1, H, hd] against this block's pool rows; per-slot positions
+    >= lengths[s] masked. Compute-dtype pools go through the single-
+    request decoder's own ``_attend_cached`` (token-exactness by shared
+    code); int8 pools run the same f32-score softmax with the block
+    scales folded into the score/probability rows."""
+    if not pool_is_int8(pool):
+        return _attend_cached(q, pool["k"][block], pool["v"][block],
+                              lengths, scale)
+    k_q, k_s = pool["k_q"][block], pool["k_s"][block]
+    v_q, v_s = pool["v_q"][block], pool["v_s"][block]
+    # scores[b,h,1,l] = (q . k_q[l,h]) * k_s[l,h]: int8 payload feeds the
+    # MXU-side contraction; the per-row scale lands on the f32 score
+    scores = jnp.einsum(
+        "bqhd,bkhd->bhqk", q.astype(jnp.float32), k_q.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    ) * scale
+    row_scale = jnp.swapaxes(k_s[..., 0], 1, 2)[:, :, None, :]  # [S,H,1,L]
+    scores = scores * row_scale
+    pos = jnp.arange(k_q.shape[1])
+    mask = pos[None, None, None, :] < jnp.reshape(lengths, (-1, 1, 1, 1))
+    scores = jnp.where(mask, scores, NEG_INF)
+    p = jax.nn.softmax(scores, axis=-1)
+    # fold v's scale into the probability row, keep v int8 in the einsum
+    pv = p * jnp.swapaxes(v_s[..., 0], 1, 2)[:, :, None, :]
+    out = jnp.einsum(
+        "bhqk,bkhd->bqhd", pv, v_q.astype(jnp.float32),
+        preferred_element_type=jnp.float32,
+    )
+    return out.astype(q.dtype)

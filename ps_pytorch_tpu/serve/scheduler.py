@@ -1,0 +1,183 @@
+"""Continuous-batching slot scheduler — pure host bookkeeping, no jax.
+
+The device side of the serving engine is a fixed pool of ``n_slots``
+KV-cache slots stepped by ONE compiled decode program; this module
+decides which request occupies which slot at each tick:
+
+- ``submit`` queues a request (FIFO; shape-validated against the pool
+  geometry at submit time, so a too-long request fails loudly at the
+  front door instead of corrupting a slot);
+- ``admit`` pops queued requests into free slots (lowest slot id first —
+  deterministic, so a replay of the same arrival order reproduces the
+  same slot assignment bit-for-bit);
+- ``record_token`` appends one generated token + its latency to the
+  slot's in-flight state and reports whether the request just finished
+  (its ``max_new_tokens`` reached);
+- ``evict`` frees a finished slot and returns the ``Completion``.
+
+Slot lifecycle:  FREE -> (admit) -> ACTIVE -> (record_token x N,
+last one finishing) -> FINISHED -> (evict) -> FREE.  Eviction and
+admission both happen between device steps, so a slot freed at tick t
+is re-usable at tick t+1 with no recompilation — static shapes, the
+masks do the rest (serve/engine.py).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from collections import deque
+from typing import Deque, Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+
+@dataclasses.dataclass(frozen=True)
+class Request:
+    """One decode request: a prompt and a new-token budget."""
+
+    rid: int
+    prompt: np.ndarray           # int32 [prompt_len], prompt_len >= 1
+    max_new_tokens: int
+    # open-loop traffic: arrival time on the caller's clock (0.0 is a
+    # legitimate instant). None = closed-loop request with no arrival —
+    # TTFT is then measured from admission.
+    arrival_s: Optional[float] = None
+
+
+@dataclasses.dataclass
+class Completion:
+    """A finished request: generated tokens + per-token latencies."""
+
+    rid: int
+    prompt: np.ndarray
+    tokens: List[int]
+    # per-token wall-clock latency: tokens[0]'s entry is time-to-first-
+    # token measured from arrival; later entries are inter-token gaps
+    latencies_s: List[float]
+    finished_s: float = 0.0
+    # the checkpoint step whose weights generated this completion (the
+    # drain-then-swap rollover rule means it is ONE step, never a mix)
+    weights_step: Optional[int] = None
+
+
+@dataclasses.dataclass
+class _InFlight:
+    request: Request
+    slot: int
+    tokens: List[int]
+    latencies_s: List[float]
+    last_token_s: float          # arrival at admission; then last emit
+
+
+class SlotScheduler:
+    """Admit/evict bookkeeping for a fixed pool of decode slots."""
+
+    def __init__(self, n_slots: int, max_len: int, max_prompt_len: int):
+        if n_slots < 1:
+            raise ValueError(f"n_slots must be >= 1, got {n_slots}")
+        if not 1 <= max_prompt_len <= max_len:
+            raise ValueError(
+                f"need 1 <= max_prompt_len ({max_prompt_len}) <= "
+                f"max_len ({max_len})"
+            )
+        self.n_slots = n_slots
+        self.max_len = max_len
+        self.max_prompt_len = max_prompt_len
+        self._free: List[int] = sorted(range(n_slots), reverse=True)
+        self._queue: Deque[Request] = deque()
+        self._inflight: Dict[int, _InFlight] = {}
+
+    # ------------------------------------------------------------- intake
+    def submit(self, request: Request) -> None:
+        plen = int(request.prompt.shape[0])
+        if plen < 1:
+            raise ValueError(f"request {request.rid}: empty prompt")
+        if plen > self.max_prompt_len:
+            raise ValueError(
+                f"request {request.rid}: prompt length {plen} exceeds "
+                f"max_prompt_len {self.max_prompt_len}"
+            )
+        if request.max_new_tokens < 1:
+            raise ValueError(
+                f"request {request.rid}: max_new_tokens must be >= 1"
+            )
+        if plen + request.max_new_tokens > self.max_len:
+            raise ValueError(
+                f"request {request.rid}: prompt {plen} + new "
+                f"{request.max_new_tokens} exceeds slot length "
+                f"{self.max_len}"
+            )
+        self._queue.append(request)
+
+    # ---------------------------------------------------------- admission
+    def admit(self, now_s: float = 0.0) -> List[Tuple[int, Request]]:
+        """Move queued requests into free slots (FIFO x lowest-slot-first);
+        returns the (slot, request) pairs admitted this tick — the engine
+        prefills exactly these."""
+        admitted: List[Tuple[int, Request]] = []
+        while self._queue and self._free:
+            req = self._queue.popleft()
+            slot = self._free.pop()
+            # TTFT base: the request's ARRIVAL when it carries one on the
+            # caller's clock (open-loop traffic — queueing delay counts,
+            # and 0.0 is a legitimate arrival instant), else the
+            # admission instant (closed-loop/default requests)
+            self._inflight[slot] = _InFlight(
+                request=req, slot=slot, tokens=[], latencies_s=[],
+                last_token_s=(
+                    req.arrival_s if req.arrival_s is not None else now_s
+                ),
+            )
+            admitted.append((slot, req))
+        return admitted
+
+    # ------------------------------------------------------------- decode
+    def record_token(self, slot: int, token: int, now_s: float) -> bool:
+        """Append one generated token; True when the request just hit its
+        new-token budget (caller evicts)."""
+        inf = self._inflight[slot]
+        inf.tokens.append(int(token))
+        inf.latencies_s.append(max(now_s - inf.last_token_s, 0.0))
+        inf.last_token_s = now_s
+        return len(inf.tokens) >= inf.request.max_new_tokens
+
+    def evict(self, slot: int, now_s: float = 0.0,
+              weights_step: Optional[int] = None) -> Completion:
+        inf = self._inflight.pop(slot)
+        self._free.append(slot)
+        self._free.sort(reverse=True)
+        return Completion(
+            rid=inf.request.rid,
+            prompt=inf.request.prompt,
+            tokens=inf.tokens,
+            latencies_s=inf.latencies_s,
+            finished_s=now_s,
+            weights_step=weights_step,
+        )
+
+    # ----------------------------------------------------------- queries
+    @property
+    def active_slots(self) -> Sequence[int]:
+        return sorted(self._inflight)
+
+    def request_in(self, slot: int) -> Request:
+        return self._inflight[slot].request
+
+    def tokens_in(self, slot: int) -> List[int]:
+        return self._inflight[slot].tokens
+
+    @property
+    def n_inflight(self) -> int:
+        return len(self._inflight)
+
+    @property
+    def n_queued(self) -> int:
+        return len(self._queue)
+
+    @property
+    def n_free(self) -> int:
+        return len(self._free)
+
+    @property
+    def idle(self) -> bool:
+        return not self._inflight and not self._queue

@@ -38,6 +38,7 @@ from ps_pytorch_tpu.check import (
     DonationSpec,
     FusionSpec,
     GradReduce,
+    ServePolicy,
     WireAllowance,
     WirePolicy,
 )
@@ -248,6 +249,61 @@ def _defused() -> ContractSpec:
     )
 
 
+def _serve_chatty() -> ContractSpec:
+    """BUG fixture: a training-style metrics pmean rides the serving
+    decode step — the slot-parallel hot path must be collective-free."""
+
+    def build() -> Built:
+        mesh = _mesh_1d()
+        pool_spec = {"k": P(AXIS), "v": P(AXIS)}
+
+        def f(p, pool, x):
+            stat = lax.pmean(jnp.sum(x * p[0]), AXIS)  # BUG
+            return {"k": pool["k"] + 1.0, "v": pool["v"]}, stat
+
+        step = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), pool_spec, P(AXIS)),
+            out_specs=(pool_spec, P()), check_vma=False,
+        ))
+        pool = {
+            "k": jax.ShapeDtypeStruct((N, 4), jnp.float32),
+            "v": jax.ShapeDtypeStruct((N, 4), jnp.float32),
+        }
+        params, x = _args(8)
+        return Built(step=step, args=(params, pool, x),
+                     select_params=lambda out: out[0])
+
+    return ContractSpec(
+        name="serve_chatty", build=build, axes=(AXIS,),
+        serve=ServePolicy(kv_argnum=1, quantized=False,
+                          kv_dtype="float32"),
+    )
+
+
+def _serve_f32_kv() -> ContractSpec:
+    """BUG fixture: the contract declares an int8-quantized KV pool but
+    the step's pool arg carries plain f32 K/V — unquantized storage
+    crept into a declared-int8 serving cache."""
+
+    def build() -> Built:
+        def f(p, pool, tok):
+            return {"k": pool["k"] + p[0], "v": pool["v"]}, tok
+
+        pool = {
+            "k": jax.ShapeDtypeStruct((N, 4), jnp.float32),
+            "v": jax.ShapeDtypeStruct((N, 4), jnp.float32),
+        }
+        params, _ = _args(8)
+        tok = jax.ShapeDtypeStruct((N,), jnp.int32)
+        return Built(step=jax.jit(f), args=(params, pool, tok),
+                     select_params=lambda out: out[0])
+
+    return ContractSpec(
+        name="serve_f32_kv", build=build, axes=(),
+        serve=ServePolicy(kv_argnum=1, quantized=True),
+    )
+
+
 def _ok_psum() -> ContractSpec:
     return ContractSpec(
         name="ok_psum",
@@ -267,5 +323,7 @@ def get_contracts():
         _undonated(),
         _donate_mismatch(),
         _defused(),
+        _serve_chatty(),
+        _serve_f32_kv(),
         _ok_psum(),
     )

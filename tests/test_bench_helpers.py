@@ -52,6 +52,57 @@ def test_dec_tag_encodes_overrides(bench, monkeypatch):
     assert bench._dec_tag() == "d512x6_p128_n256_b8_f32"
 
 
+def test_srv_tag_shares_the_decode_shape_parser(bench, monkeypatch):
+    """The serve leg's tag reads the SAME BENCH_DEC_* model-shape envs as
+    the decode leg (one metric-shape helper, _dec_shape_tag) plus its own
+    slots/rate knobs — an override moves BOTH tags, so the two legs'
+    records can never describe different models under the same shape."""
+    monkeypatch.delenv("BENCH_DTYPE", raising=False)
+    for var in ("BATCH", "PROMPT", "NEW", "DIM", "DEPTH"):
+        monkeypatch.delenv(f"BENCH_DEC_{var}", raising=False)
+    for var in ("SLOTS", "REQS", "RATE"):
+        monkeypatch.delenv(f"BENCH_SRV_{var}", raising=False)
+    monkeypatch.delenv("BENCH_SRV_INT8KV", raising=False)
+    assert bench._srv_tag() == "d512x6_p128_n128_s8_r100"
+    monkeypatch.setenv("BENCH_DEC_DIM", "256")
+    assert bench._dec_tag().startswith("d256x6_")
+    assert bench._srv_tag().startswith("d256x6_")
+    monkeypatch.setenv("BENCH_SRV_SLOTS", "16")
+    monkeypatch.setenv("BENCH_SRV_INT8KV", "1")
+    monkeypatch.setenv("BENCH_DTYPE", "float32")
+    assert bench._srv_tag() == "d256x6_p128_n128_s16_r100_q8kv_f32"
+    monkeypatch.setenv("BENCH_SRV_RATE", "0.5")
+    assert "_r0.5_" in bench._srv_tag()
+
+
+def test_srv_knob_validation(bench, monkeypatch):
+    monkeypatch.setenv("BENCH_WORKLOAD", "serve")
+    bench._validate_env()  # defaults pass
+    monkeypatch.setenv("BENCH_SRV_SLOTS", "0")
+    with pytest.raises(SystemExit):
+        bench._validate_env()
+    monkeypatch.setenv("BENCH_SRV_SLOTS", "8")
+    monkeypatch.setenv("BENCH_SRV_INT8KV", "yes")
+    with pytest.raises(SystemExit):
+        bench._validate_env()
+    monkeypatch.setenv("BENCH_SRV_INT8KV", "1")
+    bench._validate_env()
+    # rate is a FLOAT (sub-1 rps open-loop regimes are benchable) but
+    # must be a finite positive number
+    monkeypatch.setenv("BENCH_SRV_RATE", "0.5")
+    bench._validate_env()
+    assert bench._srv_rate() == 0.5
+    for bad in ("0", "-1", "nan", "lots"):
+        monkeypatch.setenv("BENCH_SRV_RATE", bad)
+        with pytest.raises(SystemExit):
+            bench._validate_env()
+    monkeypatch.delenv("BENCH_SRV_RATE")
+    # CNN-only knobs refuse the serve workload too
+    monkeypatch.setenv("BENCH_COMPRESS", "int8")
+    with pytest.raises(SystemExit):
+        bench._validate_env()
+
+
 def test_cnn_compress_override_tags_metric(bench, monkeypatch):
     monkeypatch.delenv("BENCH_COMPRESS", raising=False)
     monkeypatch.delenv("BENCH_DTYPE", raising=False)
@@ -171,11 +222,15 @@ def test_success_metric_covers_all_workloads(bench, monkeypatch):
     for var in list(bench._LM_DEFAULTS) + list(bench._DEC_DEFAULTS):
         monkeypatch.delenv(f"BENCH_LM_{var}", raising=False)
         monkeypatch.delenv(f"BENCH_DEC_{var}", raising=False)
+    for var in list(bench._SRV_DEFAULTS) + ["RATE"]:
+        monkeypatch.delenv(f"BENCH_SRV_{var}", raising=False)
+    monkeypatch.delenv("BENCH_SRV_INT8KV", raising=False)
     cases = {
         "lenet": "lenet_mnist_b8192_train_throughput",
         "resnet18": "resnet18_cifar10_b1024_train_throughput",
         "lm": "lm_d512x6_s1024_b8_train_tokens_per_sec",
         "decode": "decode_d512x6_p128_n128_b8_new_tokens_per_sec",
+        "serve": "serve_d512x6_p128_n128_s8_r100_tokens_per_sec",
     }
     for wl, want in cases.items():
         monkeypatch.setenv("BENCH_WORKLOAD", wl)

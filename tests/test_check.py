@@ -163,7 +163,8 @@ def fixture_contract(tmp_path_factory):
     data = json.loads(path.read_text())
     assert set(data["configs"]) == {
         "dead_axis", "metrics_only", "fat_f32_wire", "drift",
-        "undonated", "donate_mismatch", "defused", "ok_psum",
+        "undonated", "donate_mismatch", "defused", "serve_chatty",
+        "serve_f32_kv", "ok_psum",
     }
     data["configs"]["drift"]["collectives"][0]["bytes"] += 1
     path.write_text(json.dumps(data))
@@ -180,6 +181,8 @@ def fixture_contract(tmp_path_factory):
         ("undonated", "PSC105"),
         ("donate_mismatch", "PSC105"),
         ("defused", "PSC106"),
+        ("serve_chatty", "PSC107"),
+        ("serve_f32_kv", "PSC107"),
     ],
 )
 def test_fixture_trips_exactly_one_rule(fixture_contract, name, rule):
@@ -234,6 +237,8 @@ def test_cli_list_names_registry_configs():
     assert "ps_int8_replicated_bucketed" in names
     assert "ps_resnet18_int8_replicated_bucketed" in names
     assert "dp_tp_pp" in names
+    assert "serve_decode" in names
+    assert "serve_decode_int8kv" in names
 
 
 def test_check_sh_exits_nonzero_on_fixture_violation(fixture_contract):
@@ -270,7 +275,7 @@ def test_check_sh_write_with_contract_value_is_not_refused(tmp_path):
     # rc 1: the broken fixtures trip their rules, but the write happened
     # (no exit-2 refusal from the shell gate)
     assert proc.returncode == 1, proc.stdout + proc.stderr
-    assert "wrote 8 config(s)" in proc.stdout
+    assert "wrote 10 config(s)" in proc.stdout
     assert out.exists()
 
 
@@ -352,6 +357,20 @@ def test_committed_contract_pins_bucketing_collapse():
     # and the fused LeNet variants collapse to exactly one reduce
     for name in ("ps_int8_replicated_bucketed",):
         assert grad_psums(name) == 1, committed["configs"][name]
+
+
+def test_committed_contract_pins_a_silent_serving_wire():
+    """The serving hot path in artifact form: both serve_decode configs
+    are pinned with ZERO collectives and zero wire bytes — any
+    communication creeping into the request loop diffs loudly (PSC104)
+    on top of failing PSC107."""
+    committed = load_contract(str(CONTRACT))
+    for name in ("serve_decode", "serve_decode_int8kv"):
+        entry = committed["configs"][name]
+        assert entry["collectives"] == [], entry
+        assert entry["n_collectives"] == 0
+        assert entry["total_bytes"] == 0
+        assert entry["axes"] == []
 
 
 def test_check_sh_gate_passes():

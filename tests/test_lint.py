@@ -261,6 +261,47 @@ def test_psl004_real_trainer_is_windowed():
     assert findings == []
 
 
+PSL004_TICK = """
+import jax
+import numpy as np
+
+class Engine:
+    def tick(self):
+        pool, nxt = self._decode(self._pool)
+        return np.asarray(jax.device_get(nxt))
+"""
+
+
+def test_psl004_serve_tick_is_a_hot_loop_body():
+    """The serving engine's per-step entry point (tick) is a loop body
+    by contract — its caller invokes it once per decode step — so a
+    host fetch inside it flags even with the `while` in another
+    function. Scope: THE serve engine module (a path-suffix entry in
+    HOT_MODULES — an unrelated file that happens to be named engine.py
+    is not captured)."""
+    assert _rules(
+        _lint(PSL004_TICK, path="ps_pytorch_tpu/serve/engine.py")
+    ) == ["PSL004"]
+    # a generic engine.py elsewhere, or any other module: out of scope
+    assert _lint(PSL004_TICK, path="tools/engine.py") == []
+    assert _lint(PSL004_TICK, path="pipeline.py") == []
+
+
+def test_psl004_real_serve_engine_has_one_blessed_fetch():
+    """The production request loop's ONLY host sync is the scheduler's
+    fused [slots] token fetch, and it carries the pragma — any further
+    per-token sync creeping into serve/ fails the gate."""
+    findings = [
+        f for f in lint_paths(
+            [str(REPO / "ps_pytorch_tpu" / "serve")]
+        )
+        if f.rule in ("PSL002", "PSL004")
+    ]
+    assert findings == []
+    src = (REPO / "ps_pytorch_tpu" / "serve" / "engine.py").read_text()
+    assert src.count("# psl: sync-ok") == 1
+
+
 # ------------------------------------------------------------------- PSL005
 
 PSL005_POSITIVE = """

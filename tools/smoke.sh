@@ -5,8 +5,9 @@
 # parallelism axes), the PS CNN trainer + evaluator, the flat-state
 # default (int8 + EF + guard NaN-inject), the LM trainer on tp with
 # vocab-parallel embedding + the LM evaluator with KV-cache sampling,
-# and the headline benchmark in its trimmed form. Budget ~6 minutes of
-# CPU (compiles dominate).
+# the serving engine under open-loop traffic with one hot checkpoint
+# rollover, and the headline benchmark in its trimmed form. Budget
+# ~6 minutes of CPU (compiles dominate).
 #
 #   bash tools/smoke.sh
 set -euo pipefail
@@ -73,6 +74,26 @@ run python -m ps_pytorch_tpu.cli.train_lm \
     --train-dir "$TMP/lm" --eval-freq 10
 run python -m ps_pytorch_tpu.cli.evaluate_lm \
     --model-dir "$TMP/lm" --once --generate 16
+
+# serving leg (ARCHITECTURE §7e): serve the freshly-trained LM from its
+# step-10 checkpoint under the open-loop traffic generator on the same
+# 8-device mesh; the poll must hot-roll onto step 20 mid-serve
+# (drain-then-swap), every request must complete, and the latency tail
+# must be finite
+run python -m ps_pytorch_tpu.cli.serve \
+    --model-dir "$TMP/lm" --step 10 --slots 8 --max-len 64 \
+    --requests 24 --rate 40 --prompt-min 4 --prompt-max 12 \
+    --new-min 8 --new-max 16 --poll-interval 0.1 --num-workers 8 \
+    --summary-file "$TMP/serve.json"
+run python - "$TMP/serve.json" <<'PYEOF'
+import json, math, sys
+s = json.load(open(sys.argv[1]))
+assert s["requests_completed"] == 24 and s["new_tokens"] > 0, s
+assert math.isfinite(s["p99_token_latency_s"]), s
+assert s["weights_step"] == 20 and len(s["rollovers"]) == 1, s
+print("serve smoke: %d tokens at %.1f tok/s, p99 %.4fs, rollover 10->20"
+      % (s["new_tokens"], s["tokens_per_sec"], s["p99_token_latency_s"]))
+PYEOF
 
 run python bench.py
 
