@@ -9,14 +9,16 @@ optimizer (PSC102), compressed wires stay int8 (PSC103), per-collective
 wire bytes round-trip against runs/comm_contract.json (PSC104),
 donation survives lowering (PSC105), bucketed wires stay fused — no
 more gradient-path collectives than the declared bucket plan allows
-(PSC106) — and the serving hot path stays collective-free with an
-honest KV storage dtype (PSC107).
+(PSC106) — the serving hot path stays collective-free with an
+honest KV storage dtype (PSC107), and adaptive-mask configs keep their
+grad-reduce declaration and byte envelope (PSC108).
 
 Entry points: ``python -m ps_pytorch_tpu.check``, ``tools/check.sh``,
 and the tier-1 gate in tests/test_check.py.
 """
 
 from .contracts import (
+    AdaptivePolicy,
     Built,
     ContractSpec,
     DonationSpec,
@@ -42,6 +44,7 @@ from .rules import RULE_IDS
 from .walker import Collective, collect_collectives, summarize
 
 __all__ = [
+    "AdaptivePolicy",
     "Built",
     "CheckFinding",
     "Collective",

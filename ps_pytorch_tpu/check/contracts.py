@@ -114,6 +114,27 @@ class FusionSpec:
 
 
 @dataclasses.dataclass(frozen=True)
+class AdaptivePolicy:
+    """PSC108: the adaptive-partial-aggregation contract.
+
+    A config taking a traced aggregation count (PSConfig.
+    num_aggregate_min/max) must still declare a ``grad_reduce``
+    requirement — the mask is a pre-reduce multiply, so PSC102's
+    dataflow rule (the masked reduce feeds the updated params) applies
+    unchanged, and PSC108 fails a spec that opted out of declaring it.
+    It must also keep its gradient-path reduce collectives inside
+    ``envelope_bytes``: adaptation reshapes VALUES (which workers'
+    gradients are non-zero, what the denominator is), never bytes — a
+    traced count that started moving per-count payloads (e.g. a gather
+    of the mask, or a resize of the wire) is a regression this pin
+    catches."""
+
+    min_aggregate: int
+    max_aggregate: int
+    envelope_bytes: int
+
+
+@dataclasses.dataclass(frozen=True)
 class ServePolicy:
     """PSC107: the serving hot path's contract (serve/engine.py).
 
@@ -151,6 +172,7 @@ class ContractSpec:
     donation: Optional[DonationSpec] = None
     fusion: Optional[FusionSpec] = None
     serve: Optional[ServePolicy] = None
+    adaptive: Optional[AdaptivePolicy] = None
 
 
 # metrics / loss pmean: a handful of f32 scalars, every scheme emits it
@@ -238,9 +260,14 @@ def _cnn_ps_built(cfg, network: str) -> Built:
         "label": jax.ShapeDtypeStruct((cfg.num_workers,), jnp.int32),
     }
     key = jax.eval_shape(lambda: jax.random.key(0))
+    args = (state, batch, key)
+    if cfg.adaptive_aggregate:
+        # the traced per-window aggregation count (same compiled step
+        # for every value — the whole point of the adaptive signature)
+        args += (jax.ShapeDtypeStruct((), jnp.int32),)
     return Built(
         step=step,
-        args=(state, batch, key),
+        args=args,
         select_params=lambda out: out[0].params,
     )
 
@@ -252,6 +279,7 @@ def _ps_spec(
     bucket_bytes: Optional[int] = None,
     network: str = "LeNet",
     state_layout: str = "flat",
+    adaptive: bool = False,
 ) -> ContractSpec:
     from ..parallel.mesh import DCN_AXIS, WORKER_AXIS
 
@@ -262,6 +290,8 @@ def _ps_spec(
         name = name.replace("ps_", f"ps_{network.lower()}_", 1)
     if bucket_bytes is not None:
         name += "_bucketed"
+    if adaptive:
+        name += "_adaptive"
     if state_layout != "flat":
         # layout-parity twins only (layout_parity_pairs) — the registry
         # itself carries the default layout, and state layout is
@@ -281,6 +311,8 @@ def _ps_spec(
             dcn_hosts=dcn_hosts,
             bucket_bytes=bucket_bytes,
             state_layout=state_layout,
+            num_aggregate_min=2 if adaptive else None,
+            num_aggregate_max=MESH_DEVICES if adaptive else None,
         )
 
     def build() -> Built:
@@ -337,6 +369,28 @@ def _ps_spec(
             per_bucket=2 if dcn_hosts > 1 else 1,
         )
 
+    adaptive_policy = None
+    if adaptive:
+        # the envelope: exactly the bytes the equivalent STATIC config's
+        # gradient reduce moves — adaptation must not add any. Both
+        # registered adaptive wires carry 4 B/element on the reduce path
+        # (f32 psum; int32 psum_scatter for the ZeRO-1 int8 wire), over
+        # the engine's own padded bucket plan, so the bound is derived
+        # from the same plan_buckets the step uses.
+        from ..parallel.buckets import plan_buckets
+        from ..parallel.ps import wire_align
+
+        cfg = make_cfg()
+        plan = plan_buckets(
+            payload_bytes(network) // 4, cfg.bucket_bytes or 0,
+            align=wire_align(cfg),
+        )
+        adaptive_policy = AdaptivePolicy(
+            min_aggregate=cfg.num_aggregate_min,
+            max_aggregate=cfg.num_aggregate_max,
+            envelope_bytes=plan.padded_total * 4,
+        )
+
     return ContractSpec(
         name=name,
         build=build,
@@ -345,6 +399,7 @@ def _ps_spec(
         wire=wire,
         donation=DonationSpec(argnums=(0,), out_positions=(0,)),
         fusion=fusion,
+        adaptive=adaptive_policy,
     )
 
 
@@ -634,6 +689,13 @@ def get_contracts() -> Tuple[ContractSpec, ...]:
             bucket_bytes=RESNET_BUCKET_BYTES,
         )
     )
+    # adaptive partial aggregation (PSC108): the traced-count mask on the
+    # fused replicated wire and on the ZeRO-1 int8 scatter — the two
+    # paths whose masking/denominator code diverges in ps.py
+    specs.append(
+        _ps_spec(None, "replicated", bucket_bytes=0, adaptive=True)
+    )
+    specs.append(_ps_spec("int8", "sharded", adaptive=True))
     specs.extend(
         [_dp_tp_spec(), _pp_spec(), _moe_spec(), _dp_tp_pp_spec()]
     )

@@ -30,6 +30,12 @@
 |        | dtype (int8 payload + f32 block scales when quantized; the      |
 |        | compute dtype otherwise) — an f32 leaf in a declared-int8 pool  |
 |        | is the serving analogue of a PSC103 wire regression             |
+| PSC108 | adaptive-mask regressions: a config declaring an AdaptivePolicy |
+|        | (traced aggregation count, PSConfig.num_aggregate_min/max) must |
+|        | still declare its grad-reduce requirement — so PSC102's         |
+|        | dataflow rule keeps pinning the masked reduce — and its         |
+|        | gradient-path reduce bytes must stay inside the declared        |
+|        | envelope: adaptation reshapes values, never wire bytes          |
 """
 
 from __future__ import annotations
@@ -40,7 +46,7 @@ from .core import CheckFinding, TraceResult
 from .walker import REDUCE_KINDS
 
 RULE_IDS = ("PSC101", "PSC102", "PSC103", "PSC104", "PSC105", "PSC106",
-            "PSC107")
+            "PSC107", "PSC108")
 
 
 def psc101_axes(r: TraceResult) -> List[CheckFinding]:
@@ -195,6 +201,42 @@ def psc107_serve(r: TraceResult) -> List[CheckFinding]:
     return out
 
 
+def psc108_adaptive(r: TraceResult) -> List[CheckFinding]:
+    """The adaptive-mask contract: (a) the spec must keep a grad_reduce
+    declaration — the traced count is a pre-reduce multiply, so PSC102's
+    "masked reduce feeds the updated params" check is the dataflow rule
+    and PSC108 refuses the opt-out of it; (b) the gradient-path reduce
+    collectives must fit the declared byte envelope — a mask count is
+    VALUES (which workers contribute, what divides the sum), so any
+    per-count growth of the wire (mask gathers, resized payloads) is a
+    regression."""
+    ap = r.spec.adaptive
+    if ap is None:
+        return []
+    out = []
+    if not r.spec.grad_reduce:
+        out.append(CheckFinding(
+            "PSC108", r.spec.name,
+            "adaptive aggregation declared but no grad_reduce "
+            "requirement — without it PSC102 cannot pin the masked "
+            "reduce's dataflow to the updated params",
+        ))
+    got = sum(
+        c.bytes
+        for c in r.collectives
+        if c.feeds_params and c.kind in REDUCE_KINDS
+    )
+    if got > ap.envelope_bytes:
+        out.append(CheckFinding(
+            "PSC108", r.spec.name,
+            f"gradient-path reduce collectives move {got} B, but the "
+            f"adaptive envelope (counts {ap.min_aggregate}-"
+            f"{ap.max_aggregate}) declares at most {ap.envelope_bytes} B "
+            f"— the traced mask must reshape values, not add wire bytes",
+        ))
+    return out
+
+
 def psc105_donation(r: TraceResult) -> List[CheckFinding]:
     if r.spec.donation is None:
         return []
@@ -219,6 +261,7 @@ def check_result(r: TraceResult) -> List[CheckFinding]:
         + psc105_donation(r)
         + psc106_fusion(r)
         + psc107_serve(r)
+        + psc108_adaptive(r)
     )
 
 

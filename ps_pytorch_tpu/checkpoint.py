@@ -472,7 +472,14 @@ def load_checkpoint(target, model_dir: str, step: int):
     fresh value (counters re-zeroed); present but disabled in the target
     -> dropped. They are observability, and must never strand a
     checkpoint the way lost EF residuals would."""
-    raw = _restore_raw(model_dir, step)
+    return restore_from_raw(target, _restore_raw(model_dir, step), step)
+
+
+def restore_from_raw(target, raw, step: int):
+    """The merge half of ``load_checkpoint``: raw nested dicts (already
+    read and decoded — or transformed, e.g. by the elastic resume-reshape
+    in resilience/elastic.py) into the structure of ``target``, with the
+    same forward-compat and RESETTABLE_FIELDS rules documented there."""
     tgt_dict = serialization.to_state_dict(target)
     if isinstance(raw, dict) and isinstance(tgt_dict, dict):
         for k, v in tgt_dict.items():

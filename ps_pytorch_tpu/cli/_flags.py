@@ -19,9 +19,12 @@ Deliberate mappings (documented divergences):
 from __future__ import annotations
 
 import argparse
+import logging
 
 from ..parallel import PSConfig
 from ..trainer import TrainConfig
+
+logger = logging.getLogger("ps_pytorch_tpu")
 
 
 def add_train_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
@@ -80,7 +83,23 @@ def add_train_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         help="deterministic fault injection: a JSON "
                              "FaultPlan object or @path to one (also via "
                              "PS_TPU_FAULTS env); see resilience/faults.py")
+    parser.add_argument("--adapt-window", type=int, default=d.adapt_window,
+                        help="adaptive aggregation window (steps): how often "
+                             "the mask count is re-picked from step-time "
+                             "stats (with --num-aggregate-min/max)")
     return parser
+
+
+def _num_aggregate(val: str) -> int:
+    # the reference accepted any int here and the engine silently treated
+    # out-of-range values as "all workers"; a negative is always a typo
+    n = int(val)
+    if n < 0:
+        raise argparse.ArgumentTypeError(
+            f"--num-aggregate must be >= 0 (0 = aggregate all workers), "
+            f"got {n}"
+        )
+    return n
 
 
 def _bucket_bytes(val: str) -> int:
@@ -98,9 +117,19 @@ def _bucket_bytes(val: str) -> int:
 def add_ps_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--num-workers", type=int, default=0,
                         help="mesh size (0 = all visible devices)")
-    parser.add_argument("--num-aggregate", type=int, default=0,
+    parser.add_argument("--num-aggregate", type=_num_aggregate, default=0,
                         help="aggregate only K of N worker gradients per step "
-                             "(0 = all; reference --num-aggregate)")
+                             "(0 = all; values > num_workers warn and clamp "
+                             "to all; reference --num-aggregate)")
+    parser.add_argument("--num-aggregate-min", type=int, default=0,
+                        help="adaptive partial aggregation lower bound: with "
+                             "BOTH bounds set the aggregation count adapts "
+                             "per --adapt-window from straggler-watchdog "
+                             "step times (needs --mode/--kill-threshold to "
+                             "arm the watchdog); 0 = static mask")
+    parser.add_argument("--num-aggregate-max", type=int, default=0,
+                        help="adaptive partial aggregation upper bound "
+                             "(0 = static mask; see --num-aggregate-min)")
     parser.add_argument("--mask-mode", type=str, default="random_k",
                         choices=("random_k", "first_k"))
     parser.add_argument("--compress-grad", type=str, default="none",
@@ -196,13 +225,26 @@ def train_config_from(args: argparse.Namespace) -> TrainConfig:
         straggler_storm_n=args.straggler_storm_n,
         max_consecutive_skips=args.max_consecutive_skips,
         fault_plan=args.fault_plan,
+        adapt_window=args.adapt_window,
     )
 
 
 def ps_config_from(args: argparse.Namespace, num_workers: int) -> PSConfig:
+    num_aggregate = args.num_aggregate
+    if num_aggregate > num_workers:
+        # out-of-range used to SILENTLY mean "all workers" — keep the
+        # semantics (clamping to N is exactly that) but say so once
+        logger.warning(
+            "--num-aggregate %d exceeds num_workers %d: clamping to %d "
+            "(aggregate all workers)",
+            num_aggregate, num_workers, num_workers,
+        )
+        num_aggregate = num_workers
     return PSConfig(
         num_workers=num_workers,
-        num_aggregate=args.num_aggregate or None,
+        num_aggregate=num_aggregate or None,
+        num_aggregate_min=args.num_aggregate_min or None,
+        num_aggregate_max=args.num_aggregate_max or None,
         mask_mode=args.mask_mode,
         compress={
             "compress": "int8",

@@ -32,7 +32,7 @@ from .buckets import piece_stream
 def aggregation_mask(
     axis_name: str,
     num_workers: int,
-    num_aggregate: Optional[int],
+    num_aggregate,
     key: Optional[jax.Array] = None,
     mode: str = "random_k",
 ) -> jax.Array:
@@ -40,8 +40,20 @@ def aggregation_mask(
 
     Must be called inside shard_map/pmap over `axis_name`. With
     num_aggregate None or >= num_workers, every worker participates.
-    """
-    if num_aggregate is None or num_aggregate >= num_workers:
+
+    ``num_aggregate`` may be a TRACED int32 scalar (the adaptive partial
+    aggregation path, resilience/elastic.py): the selection is then
+    computed with dynamic-k arithmetic — ``random_k`` via the rank of
+    each worker in the shared permutation (worker w is selected iff
+    argsort(perm)[w] < k, exactly the set perm[:k] the static spelling
+    builds), ``first_k`` via the same ``w < k`` compare. A traced k equal
+    to num_workers yields a mask of exactly 1.0 everywhere, so the
+    full-mask adaptive step multiplies by 1.0 — bit-exact against the
+    static no-mask path."""
+    dynamic = isinstance(num_aggregate, jax.Array)
+    if not dynamic and (
+        num_aggregate is None or num_aggregate >= num_workers
+    ):
         return jnp.float32(1.0)
     w = lax.axis_index(axis_name)
     if mode == "first_k":
@@ -50,6 +62,12 @@ def aggregation_mask(
         if key is None:
             raise ValueError("random_k masking needs a (replicated) PRNG key")
         perm = jax.random.permutation(key, num_workers)
+        if dynamic:
+            # rank[w] = position of worker w in perm; rank < k <=> w is in
+            # perm[:k] — same selected set as the static scatter below,
+            # but expressible with a traced k
+            rank = jnp.argsort(perm)
+            return (rank[w] < num_aggregate).astype(jnp.float32)
         selected = jnp.zeros((num_workers,), jnp.float32).at[perm[:num_aggregate]].set(1.0)
         return selected[w]
     raise ValueError(f"unknown aggregation mode {mode!r}")
@@ -374,7 +392,7 @@ def aggregate_gradients(
     grads,
     axis_name: str,
     num_workers: int,
-    num_aggregate: Optional[int] = None,
+    num_aggregate=None,
     mask_key: Optional[jax.Array] = None,
     mask_mode: str = "random_k",
     compress: Optional[str] = None,
@@ -418,27 +436,39 @@ def aggregate_gradients(
     round's requantization noise is not residual-tracked — measured at
     ~1e-2 of the aggregate's norm (halved by block-128 scales) for the
     flat scheme's round 2, the same transform
-    (tests/test_compression.py::test_ef_untracked_round2_noise_measured)."""
-    k = (
-        num_aggregate
-        if (num_aggregate is not None and num_aggregate < num_workers)
-        else num_workers
-    )
+    (tests/test_compression.py::test_ef_untracked_round2_noise_measured).
+
+    ``num_aggregate`` may be a TRACED int32 scalar (adaptive partial
+    aggregation): the mask is then always applied (1.0 everywhere when
+    the traced count equals num_workers — bit-exact against the static
+    no-mask path on power-of-two meshes) and the denominator is the
+    traced count itself, so the aggregate stays an average over the
+    selected set at every count without retracing."""
+    dynamic = isinstance(num_aggregate, jax.Array)
+    if dynamic:
+        k = num_aggregate.astype(jnp.float32)
+    else:
+        k = (
+            num_aggregate
+            if (num_aggregate is not None and num_aggregate < num_workers)
+            else num_workers
+        )
     hier_2round = compress == "int8_2round" and isinstance(
         axis_name, (tuple, list)
     )
-    if k != num_workers:
+    if dynamic or k != num_workers:
         sel = aggregation_mask(axis_name, num_workers, num_aggregate, mask_key, mask_mode)
         grads = jax.tree_util.tree_map(lambda g: g * sel.astype(g.dtype), grads)
+    denom = k if dynamic else float(k)
     if compress in (None, "none"):
-        agg = psum_mean(grads, axis_name, float(k),
+        agg = psum_mean(grads, axis_name, denom,
                         bucket_bytes=bucket_bytes, flat_output=flat_output)
         contribution = grads  # lossless transmit: residual is zero
     elif compress == "int8":
         agg = quantized_psum(
             grads,
             axis_name,
-            float(k),
+            denom,
             block_size=quant_block_size,
             rounding=quant_rounding,
             key=quant_key,
@@ -455,7 +485,7 @@ def aggregate_gradients(
         agg = quantized_allreduce_2round_hier(
             grads,
             tuple(axis_name),
-            float(k),
+            denom,
             tuple(axis_sizes),
             block_size=quant_block_size,
             rounding=quant_rounding,
@@ -468,7 +498,7 @@ def aggregate_gradients(
         agg = quantized_allreduce_2round(
             grads,
             axis_name,
-            float(k),
+            denom,
             num_workers,
             block_size=quant_block_size,
             rounding=quant_rounding,

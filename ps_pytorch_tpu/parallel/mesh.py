@@ -147,9 +147,42 @@ def initialize_multihost(
     Pass "auto" on Cloud TPU pods: jax.distributed.initialize() with no
     arguments discovers the coordinator and process ids from the TPU
     metadata service — every host runs the identical command (tools/
-    run_multihost.sh relies on this)."""
+    run_multihost.sh relies on this).
+
+    CPU backend note (the 2-process localhost jobs tests/test_multihost.py
+    spawns): jax 0.4.37 defaults ``jax_cpu_collectives_implementation`` to
+    "none", so ANY multiprocess computation — including the assert_equal
+    psum hidden inside ``device_put`` onto a non-addressable sharding —
+    dies with "Multiprocess computations aren't implemented on the CPU
+    backend". This jaxlib ships the gloo TCP collectives, so a
+    multi-process job that is explicitly pinned to CPU flips them on
+    before the backend is created. Must run before anything touches
+    ``jax.devices()`` (backend creation reads the flag once)."""
     if coordinator_address is None:
         return
+    import os
+
+    plats = {
+        p.strip().lower()
+        for p in os.environ.get("JAX_PLATFORMS", "").split(",")
+        if p.strip()
+    }
+    if "cpu" in plats:
+        # (unset JAX_PLATFORMS is left alone: a TPU pod runs that way,
+        # and perturbing its cpu client config for a backend it never
+        # uses for collectives buys nothing)
+        jax.config.update("jax_cpu_collectives_implementation", "gloo")
+        # gloo TCP pairs match ops by FIFO order, not tags: with async
+        # dispatch two in-flight XLA computations (a train step and a
+        # host-collective psum, or a prefetch device_put's assert_equal
+        # broadcast) interleave their sends nondeterministically PER
+        # PROCESS, and a cross-process order mismatch aborts with
+        # gloo::EnforceNotMet ("op.preamble.length <= op.nbytes").
+        # Inline dispatch serializes each process's ops into strict
+        # program order — identical on every process by SPMD. CPU
+        # multiprocess is a test/dev topology; the throughput cost is
+        # irrelevant there.
+        jax.config.update("jax_cpu_enable_async_dispatch", False)
     if coordinator_address == "auto":
         jax.distributed.initialize()
         return

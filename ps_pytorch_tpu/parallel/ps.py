@@ -85,6 +85,18 @@ class PSConfig:
     axis_name: Union[str, Tuple[str, ...]] = WORKER_AXIS
     num_aggregate: Optional[int] = None
     mask_mode: str = "random_k"
+    # adaptive partial aggregation (resilience/elastic.py): when BOTH
+    # bounds are set, the train step takes a traced int32 ``agg_count``
+    # argument and the host picks next window's count from observed
+    # step-time statistics inside [min, max] — the reference's static
+    # backup-worker knob generalized to ACE-Sync-style adaptive sync.
+    # ``num_aggregate`` then only seeds the initial count (default: max).
+    # The masking/denominator math is identical to the static path; a
+    # full-count window multiplies by exactly 1.0 and divides by exactly
+    # num_workers, so it is bit-exact against num_aggregate=None on
+    # power-of-two meshes.
+    num_aggregate_min: Optional[int] = None
+    num_aggregate_max: Optional[int] = None
     # None | "int8" (int32-psum of int8 payloads: exact sum, compute-side
     # compression) | "int8_2round" (all_to_all + requantize + all_gather:
     # the wire itself carries int8 — a true ~4x bandwidth reduction, one
@@ -199,6 +211,28 @@ class PSConfig:
                 f"bad loss_scale_growth_interval "
                 f"{self.loss_scale_growth_interval}"
             )
+        if (self.num_aggregate_min is None) != (self.num_aggregate_max is None):
+            raise ValueError(
+                "adaptive aggregation needs BOTH num_aggregate_min and "
+                "num_aggregate_max (set neither for the static mask)"
+            )
+        if self.num_aggregate_min is not None:
+            if not (1 <= self.num_aggregate_min <= self.num_aggregate_max
+                    <= self.num_workers):
+                raise ValueError(
+                    f"bad adaptive bounds [{self.num_aggregate_min}, "
+                    f"{self.num_aggregate_max}]: need 1 <= min <= max <= "
+                    f"num_workers ({self.num_workers})"
+                )
+            if self.num_aggregate is not None and not (
+                self.num_aggregate_min <= self.num_aggregate
+                <= self.num_aggregate_max
+            ):
+                raise ValueError(
+                    f"num_aggregate {self.num_aggregate} (the initial "
+                    f"adaptive count) is outside the declared bounds "
+                    f"[{self.num_aggregate_min}, {self.num_aggregate_max}]"
+                )
         if self.loss_scale_init <= 0.0:
             # scale 0 zeroes the loss and the unscale divides by it: every
             # step overflows and the guard aborts blaming the DATA
@@ -231,6 +265,24 @@ class PSConfig:
         if self.num_aggregate is None or self.num_aggregate >= self.num_workers:
             return self.num_workers
         return self.num_aggregate
+
+    @property
+    def adaptive_aggregate(self) -> bool:
+        """True when the train step takes a traced per-window aggregation
+        count (``step(state, batch, key, agg_count)``) instead of baking
+        ``num_aggregate`` in statically."""
+        return self.num_aggregate_min is not None
+
+    @property
+    def initial_aggregate(self) -> int:
+        """The adaptive controller's starting count: ``num_aggregate``
+        when given (validated inside the bounds), else the max bound —
+        start optimistic, back off when stragglers appear."""
+        if not self.adaptive_aggregate:
+            return self.effective_aggregate
+        if self.num_aggregate is not None:
+            return self.num_aggregate
+        return self.num_aggregate_max
 
 
 @flax.struct.dataclass
@@ -438,7 +490,7 @@ def _worker_region(flat, plan: BucketPlan, w, n: int):
 
 
 def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key,
-                       quant_key=None, err=None):
+                       quant_key=None, err=None, agg_count=None):
     """ZeRO-1 "sharded PS": (EF add-back) -> mask -> (quantize) ->
     reduce_scatter per bucket -> per-shard optax update -> all_gather the
     parameter delta. The flat geometry comes from the buckets engine
@@ -468,17 +520,30 @@ def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key,
     gathered update adds straight onto the flat buffer).
 
     `err` (error feedback) is this worker's residual on the FLAT padded
-    gradient vector; returns (new_params, new_opt, new_err)."""
+    gradient vector; returns (new_params, new_opt, new_err).
+
+    ``agg_count`` (adaptive partial aggregation): a traced int32 count
+    replacing the static ``cfg.num_aggregate`` — the mask is always
+    applied (exactly 1.0 at full count) and the denominator is the
+    traced count, so the same compiled program serves every count in
+    the declared bounds."""
     axis, n = cfg.axis_name, cfg.num_workers
-    k = cfg.effective_aggregate
+    dynamic = agg_count is not None
+    if dynamic:
+        k = agg_count.astype(jnp.float32)
+    else:
+        k = cfg.effective_aggregate
     layout = tree_layout(grads)
     total = layout.total
     plan = _sharded_plan(cfg, total)
     flat_g = pad_flat(tree_to_flat(grads), plan)
     if err is not None:
         flat_g = flat_g + err
-    if k != n:
-        sel = aggregation_mask(axis, n, cfg.num_aggregate, mask_key, cfg.mask_mode)
+    if dynamic or k != n:
+        sel = aggregation_mask(
+            axis, n, agg_count if dynamic else cfg.num_aggregate,
+            mask_key, cfg.mask_mode,
+        )
         sent = flat_g * sel
     else:
         sent = flat_g
@@ -604,6 +669,14 @@ def make_ps_train_step(
     `faults` (resilience.FaultPlan) bakes deterministic NaN/Inf gradient
     injection into the compiled step at the planned global steps — the
     chaos harness that proves the guard end-to-end.
+
+    cfg.adaptive_aggregate (num_aggregate_min/max set) changes the step
+    signature to ``(state, batch, key, agg_count) -> (state, metrics)``:
+    ``agg_count`` is a traced int32 scalar the host updates per window
+    (resilience/elastic.AdaptiveMaskController), clipped on device to the
+    declared bounds so a host bug can never divide by zero or mask out
+    everything. Same compiled program for every count — no retrace on
+    adaptation.
     """
     axis, n = cfg.axis_name, cfg.num_workers
     specs = state_specs(cfg)
@@ -615,7 +688,14 @@ def make_ps_train_step(
     )
 
     def worker_fn(step_idx, params, opt_state, batch_stats, comm_state,
-                  guard_state, images, labels, key):
+                  guard_state, images, labels, key, agg_count=None):
+        if agg_count is not None:
+            # device-side clamp to the declared bounds: the contract the
+            # PSC108 envelope relies on must hold even against a buggy
+            # host-side controller
+            agg_count = jnp.clip(
+                agg_count, cfg.num_aggregate_min, cfg.num_aggregate_max
+            ).astype(jnp.int32)
         w = lax.axis_index(axis)
         k_step = jax.random.fold_in(key, step_idx)
         k_mask = jax.random.fold_in(k_step, 0xA66)
@@ -735,7 +815,7 @@ def make_ps_train_step(
             err = comm_state[0] if cfg.error_feedback else None
             params, new_opt, new_err = _sharded_ps_update(
                 params, opt_state, grads, tx, cfg, k_mask,
-                quant_key=quant_key, err=err,
+                quant_key=quant_key, err=err, agg_count=agg_count,
             )
             new_opt = tree_map(lambda a: a[None], new_opt)
             if cfg.error_feedback:
@@ -754,7 +834,9 @@ def make_ps_train_step(
                 grads,
                 axis,
                 n,
-                num_aggregate=cfg.num_aggregate,
+                num_aggregate=(
+                    agg_count if agg_count is not None else cfg.num_aggregate
+                ),
                 mask_key=k_mask,
                 mask_mode=cfg.mask_mode,
                 compress=cfg.compress,
@@ -819,32 +901,41 @@ def make_ps_train_step(
                 metrics["loss_scale"] = new_guard.scale
         return params, new_opt, out_bs, new_comm, new_guard, metrics
 
+    base_in_specs = (
+        P(),
+        specs.params,
+        specs.opt_state,
+        specs.batch_stats,
+        specs.comm_state,
+        specs.guard_state,
+        P(axis),
+        P(axis),
+        P(),
+    )
+    out_specs = (
+        specs.params,
+        specs.opt_state,
+        specs.batch_stats,
+        specs.comm_state,
+        specs.guard_state,
+        P(),
+    )
+    # the adaptive signature threads the traced count through shard_map
+    # (replicated scalar); the static path keeps the 9-arg shape so its
+    # jaxpr — and the committed comm contract — is untouched
     mapped = jax.shard_map(
         worker_fn,
         mesh=mesh,
         in_specs=(
-            P(),
-            specs.params,
-            specs.opt_state,
-            specs.batch_stats,
-            specs.comm_state,
-            specs.guard_state,
-            P(axis),
-            P(axis),
-            P(),
+            base_in_specs + (P(),)
+            if cfg.adaptive_aggregate
+            else base_in_specs
         ),
-        out_specs=(
-            specs.params,
-            specs.opt_state,
-            specs.batch_stats,
-            specs.comm_state,
-            specs.guard_state,
-            P(),
-        ),
+        out_specs=out_specs,
         check_vma=False,
     )
 
-    def step(state: PSTrainState, batch, key):
+    def step(state: PSTrainState, batch, key, *agg):
         params, opt_state, batch_stats, comm_state, guard_state, metrics = (
             mapped(
                 state.step,
@@ -856,6 +947,7 @@ def make_ps_train_step(
                 batch["image"],
                 batch["label"],
                 key,
+                *agg,
             )
         )
         new_state = PSTrainState(
@@ -868,6 +960,11 @@ def make_ps_train_step(
         )
         return new_state, metrics
 
+    if cfg.adaptive_aggregate:
+        def step_adaptive(state: PSTrainState, batch, key, agg_count):
+            return step(state, batch, key, agg_count)
+
+        return jax.jit(step_adaptive, donate_argnums=(0,) if donate else ())
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 

@@ -2,19 +2,41 @@
 
 Checkpoints cross a shared filesystem (the reference's NFS train_dir;
 gcsfuse on a pod — checkpoint.py docstrings), which is exactly where
-transient EIO/ESTALE lives. Retries are deterministic (fixed delays, no
-jitter: the chaos suite needs reproducible schedules) and bounded; the
+transient EIO/ESTALE lives. Delays are bounded exponential backoff with
+BOUNDED multiplicative jitter: after a shared-storage hiccup, every host
+of a pod (and every evaluator polling the same dir) retries on the same
+schedule, and jitter-free backoff re-synchronizes their I/O into the
+exact thundering herd that caused the hiccup. The jittered delay for
+attempt k is uniform in [base*2^k, base*2^k * (1+jitter)] — never
+shorter than the deterministic schedule, never more than ``jitter``
+longer, so tests reasoning about minimum backoff still hold. The noise
+source is injectable (``rng``): the chaos suite passes a seeded
+``random.Random`` for reproducible schedules; the module default is
+OS-entropy seeded so every process decorrelates unconditionally. The
 last failure propagates unchanged so callers keep the real errno."""
 
 from __future__ import annotations
 
 import logging
+import random
 import time
-from typing import Callable, Tuple, Type, TypeVar
+from typing import Callable, Optional, Tuple, Type, TypeVar
 
 T = TypeVar("T")
 
 logger = logging.getLogger("ps_pytorch_tpu")
+
+# per-process default jitter source, urandom-seeded: pod hosts are
+# separate machines/containers where the training process routinely has
+# the IDENTICAL pid (pid 1 in a container, same mpirun launch order), so
+# a pid seed would re-synchronize exactly the schedules jitter exists to
+# spread; OS entropy decorrelates unconditionally
+_DEFAULT_RNG = random.Random()
+
+# default jitter fraction: up to +25% per delay — enough to spread a
+# pod's retry herd across the backoff window, small enough to keep the
+# total retry budget within ~1.25x of the deterministic schedule
+DEFAULT_JITTER = 0.25
 
 
 def retry_io(
@@ -23,10 +45,16 @@ def retry_io(
     attempts: int = 3,
     base_delay_s: float = 0.05,
     retry_on: Tuple[Type[BaseException], ...] = (OSError,),
+    jitter: float = DEFAULT_JITTER,
+    rng: Optional[random.Random] = None,
 ) -> T:
-    """Call ``fn()`` up to ``attempts`` times, sleeping base*2^k between
-    tries. Only ``retry_on`` exceptions are retried (default: OSError —
-    corruption is NOT transient and must not be retried into)."""
+    """Call ``fn()`` up to ``attempts`` times, sleeping
+    ``base*2^k * (1 + jitter*u)`` with ``u ~ U[0,1)`` between tries
+    (``jitter=0`` restores the fully deterministic schedule). Only
+    ``retry_on`` exceptions are retried (default: OSError — corruption
+    is NOT transient and must not be retried into)."""
+    if jitter < 0:
+        raise ValueError(f"jitter must be >= 0, got {jitter}")
     for attempt in range(attempts):
         try:
             return fn()
@@ -34,6 +62,8 @@ def retry_io(
             if attempt == attempts - 1:
                 raise
             delay = base_delay_s * (2 ** attempt)
+            if jitter:
+                delay *= 1.0 + jitter * (rng or _DEFAULT_RNG).random()
             logger.warning(
                 "transient I/O failure (%s), attempt %d/%d, retrying in "
                 "%.2fs: %s",

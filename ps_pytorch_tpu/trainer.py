@@ -48,7 +48,8 @@ from .parallel import (
     make_ps_train_step,
     shard_state,
 )
-from .resilience import resolve_fault_plan
+from .resilience import AdaptiveMaskController, resolve_fault_plan
+from .resilience import elastic
 from .utils import PhaseTimer, format_eval_line, format_iter_line, get_logger
 
 logger = get_logger()
@@ -122,6 +123,12 @@ class TrainConfig:
     # steps (0 = never abort — count and log only). The guard itself is
     # PSConfig.nonfinite_guard; this is the host-side tripwire.
     max_consecutive_skips: int = 8
+    # adaptive partial aggregation window (steps): with PSConfig.
+    # num_aggregate_min/max set, the controller re-picks the aggregation
+    # count every this-many steps from the straggler watchdog's timings
+    # (resilience/elastic.AdaptiveMaskController; needs the watchdog
+    # armed — straggler_threshold_s is the slow-step criterion)
+    adapt_window: int = 20
     # deterministic fault injection: a JSON FaultPlan ('@path' to read a
     # file), resilience/faults.py; PS_TPU_FAULTS env var when unset here
     fault_plan: Optional[str] = None
@@ -150,6 +157,32 @@ class Trainer:
         # non-finite guard: skip count already reported to the host (the
         # device-side truth rides the metrics dict, fetched per window)
         self._skipped_seen = 0
+        # adaptive partial aggregation: the host half that picks each
+        # window's traced count (the train step takes it as an argument);
+        # the controller itself rejects a missing watchdog threshold —
+        # its policy consumes the watchdog's per-step walltimes
+        self._adaptive = None
+        if pcfg.adaptive_aggregate:
+            self._adaptive = AdaptiveMaskController(
+                pcfg,
+                tcfg.straggler_threshold_s,
+                tcfg.adapt_window,
+                event_sink=lambda rec: append_metrics_line(
+                    tcfg.metrics_file, rec
+                ),
+                # multi-host: hosts see different local walltimes but
+                # must trace the SAME count into the global psum; the
+                # controller applies this min-over-hosts at each window
+                # close (boundaries are step-counted, so every host
+                # reaches the collective together). One int32 DCN
+                # allgather per window — noise next to the per-step
+                # stop consensus.
+                consensus=(
+                    self._count_consensus
+                    if jax.process_count() > 1
+                    else None
+                ),
+            )
         self.faults = resolve_fault_plan(tcfg.fault_plan)
         if self.faults is not None:
             logger.warning("fault injection ACTIVE: %s", self.faults)
@@ -240,7 +273,16 @@ class Trainer:
         and broadcast, because a file torn on only some replicas of a
         shared dir would otherwise send hosts down different fallbacks —
         and JAX never cross-checks replicated values, so the run would
-        continue silently divergent."""
+        continue silently divergent.
+
+        Elastic resume (resilience/elastic.py): when the dir's
+        ``elastic.json`` manifest says the checkpoint was written under a
+        DIFFERENT mesh geometry (worker count, optimizer placement, or a
+        ZeRO-1 bucket/quant carving change), the raw state is reshaped
+        into this run's geometry before restore — params and optimizer
+        moments bit-exact, per-worker EF residuals and local BN stats
+        re-distributed — and a ``resume_reshape`` event lands in the
+        metrics JSONL."""
         steps = ckpt.available_steps(self.tcfg.train_dir)
         if jax.process_count() > 1:
             return self._try_resume_multihost(steps)
@@ -249,9 +291,7 @@ class Trainer:
         target = jax.device_get(self.state)
         for step in reversed(steps):
             try:
-                restored = ckpt.load_checkpoint(
-                    target, self.tcfg.train_dir, step
-                )
+                restored = self._restore_step(target, step)
             except ckpt.CheckpointCorruptError as e:
                 self._quarantine(step, e)
                 continue
@@ -269,6 +309,66 @@ class Trainer:
             )
             return step
         return None
+
+    def _restore_step(self, target, step: int):
+        """Load checkpoint `step` into `target`'s structure, routing
+        through the elastic reshape when the dir's geometry manifest says
+        the file was written on a different mesh. Raises exactly what
+        load_checkpoint raises (CheckpointCorruptError/OSError for
+        damage, ValueError for config mismatches), so the resume loops'
+        fallback handling is unchanged."""
+        raw = ckpt.load_checkpoint_raw(self.tcfg.train_dir, step)
+        src = elastic.load_geometry(self.tcfg.train_dir, step=step)
+        dst = elastic.geometry_of(self.pcfg)
+        if src is not None and elastic.needs_reshape(src, dst):
+            logger.warning(
+                "resume-reshape: checkpoint step %d was written on "
+                "%d workers (%s placement); reshaping onto %d workers "
+                "(%s placement)",
+                step, src.num_workers, src.opt_placement,
+                dst.num_workers, dst.opt_placement,
+            )
+            raw = elastic.reshape_raw_state(raw, src, self.pcfg, target)
+            append_metrics_line(
+                self.tcfg.metrics_file,
+                {
+                    "kind": "resume_reshape",
+                    "step": step,
+                    "from": src.to_json(),
+                    "to": dst.to_json(),
+                },
+            )
+            return ckpt.restore_from_raw(target, raw, step)
+        try:
+            restored = ckpt.restore_from_raw(target, raw, step)
+        except ValueError as e:
+            if src is None:
+                # structure mismatch with no manifest to reshape by: a
+                # pre-elastic checkpoint resumed on a changed mesh
+                raise ValueError(
+                    f"cannot restore checkpoint step {step}: {e}. No "
+                    f"elastic.json manifest (or per-step entry) in "
+                    f"{self.tcfg.train_dir!r} — if the mesh geometry "
+                    f"changed since this checkpoint was written, resume "
+                    f"once on the ORIGINAL geometry (which now writes "
+                    f"the manifest) and then reshape."
+                ) from e
+            raise
+        if src is None and self.pcfg.opt_placement == "sharded":
+            # the one geometry change shapes canNOT catch: a ZeRO-1
+            # bucket/quant re-carving keeps the stacked [n, shard]
+            # moment shapes and only permutes the worker->region
+            # mapping. Without a manifest we cannot verify it, so say
+            # so instead of staying silent.
+            logger.warning(
+                "resumed checkpoint step %d without an elastic manifest "
+                "entry: cannot verify its ZeRO-1 carving matches "
+                "--bucket-bytes/--quant-block-size — if those changed "
+                "since it was written, optimizer moments are silently "
+                "mis-mapped; resume on the original settings if unsure",
+                step,
+            )
+        return restored
 
     def _sync_guard_baseline(self) -> None:
         """A restored GuardState carries the LIFETIME skip count — seed
@@ -319,7 +419,7 @@ class Trainer:
         if chosen < 0:
             return None
         target = jax.device_get(self.state)
-        restored = ckpt.load_checkpoint(target, self.tcfg.train_dir, chosen)
+        restored = self._restore_step(target, chosen)
         self.state = shard_state(restored, self.mesh, self.pcfg)
         self._sync_guard_baseline()
         logger.info(
@@ -396,6 +496,30 @@ class Trainer:
                 "consecutive": self._straggler_streak,
             },
         )
+
+    @staticmethod
+    def _count_consensus(proposed: int) -> int:
+        """Mesh-wide agreement on the next window's aggregation count:
+        min over hosts of the local proposals — a straggler seen by ANY
+        host shrinks the mask for everyone; recovery needs every host
+        clean. Collective (host allgather): every host reaches the same
+        window boundary on the same step, like _stop_consensus."""
+        from jax.experimental import multihost_utils
+
+        return int(np.min(multihost_utils.process_allgather(
+            np.asarray([proposed], np.int32)
+        )))
+
+    def _record_geometry(self, step_no: int) -> None:
+        """Record this run's mesh geometry in the elastic.json manifest
+        (single writer), keyed by checkpoint step — an elastically
+        resumed dir holds mixed-geometry checkpoints, and a fallback
+        resume must reshape each file by the geometry that WROTE it."""
+        if jax.process_index() == 0:
+            elastic.save_geometry(
+                self.tcfg.train_dir, elastic.geometry_of(self.pcfg),
+                step=step_no,
+            )
 
     # ------------------------------------------------------------ graceful stop
     def request_stop(self) -> None:
@@ -571,9 +695,17 @@ class Trainer:
                     with timer.phase("fetch"):
                         sharded = next(prefetched)
                     with timer.phase("step"):
-                        self.state, metrics = self._train_step(
-                            self.state, sharded, self._key
-                        )
+                        if self._adaptive is not None:
+                            # the traced per-window count: same compiled
+                            # program for every value in the bounds
+                            self.state, metrics = self._train_step(
+                                self.state, sharded, self._key,
+                                np.int32(self._adaptive.count),
+                            )
+                        else:
+                            self.state, metrics = self._train_step(
+                                self.state, sharded, self._key
+                            )
                         if self.faults is not None:
                             # injected host stall, inside the timed phase
                             # so the watchdog sees it as a real slow step
@@ -591,6 +723,11 @@ class Trainer:
                         # turns it into a graceful checkpointed stop
                         self.faults.maybe_sigterm(step_no)
                     window_steps += 1
+                    if self._adaptive is not None and step_no != first_step:
+                        # the controller eats the same walltime the
+                        # watchdog reads (real: its barrier is armed);
+                        # the compile step is exempt like the watchdog's
+                        self._adaptive.record(step_no, timer.total)
                     # counts even with the watchdog's per-step barrier:
                     # block_until_ready syncs but never FETCHES, and the
                     # guard's host half (skip events + the abort) needs
@@ -725,6 +862,7 @@ class Trainer:
                         and t.eval_freq > 0
                         and step_no % t.eval_freq == 0
                     ):
+                        self._record_geometry(step_no)
                         self._ckpt.save(
                             self.state,
                             t.train_dir,
@@ -743,6 +881,7 @@ class Trainer:
                         done = True
                         break
             if t.save_checkpoints and metrics and last_saved != step_no:
+                self._record_geometry(step_no)
                 self._ckpt.save(
                     self.state,
                     t.train_dir,
@@ -769,6 +908,9 @@ class Trainer:
         if self.straggler_steps:
             out["straggler_steps"] = float(self.straggler_steps)
             out["straggler_storms"] = float(self.straggler_storms)
+        if self._adaptive is not None:
+            out["agg_count"] = float(self._adaptive.count)
+            out["mask_adaptations"] = float(self._adaptive.adaptations)
         return out
 
     # ---------------------------------------------------------------- validate

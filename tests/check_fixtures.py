@@ -19,6 +19,9 @@ shaped so every rule's failure mode exists somewhere runnable:
 - defused:        declares a fused (single-bucket) wire but emits one
                   psum per "leaf" — the de-fusion regression PSC106
                   exists for
+- adaptive_fat_wire: declares an adaptive-mask envelope smaller than
+                  the gradient psum actually moves — the
+                  bytes-per-count regression PSC108 exists for
 - ok_psum:        fully clean (the negative control)
 """
 
@@ -33,6 +36,7 @@ from jax import lax
 from jax.sharding import Mesh, PartitionSpec as P
 
 from ps_pytorch_tpu.check import (
+    AdaptivePolicy,
     Built,
     ContractSpec,
     DonationSpec,
@@ -304,6 +308,21 @@ def _serve_f32_kv() -> ContractSpec:
     )
 
 
+def _adaptive_fat_wire() -> ContractSpec:
+    # a perfectly healthy psum step (PSC101/102/105 clean, no donation
+    # declared) whose AdaptivePolicy envelope is smaller than the 8-leaf
+    # f32 psum's 32 B — only the PSC108 byte pin can trip
+    return ContractSpec(
+        name="adaptive_fat_wire",
+        build=lambda: _built(_clean_step(donate=False), 8),
+        axes=(AXIS,),
+        grad_reduce=(GradReduce(AXIS, ("psum",)),),
+        adaptive=AdaptivePolicy(
+            min_aggregate=2, max_aggregate=N, envelope_bytes=16
+        ),
+    )
+
+
 def _ok_psum() -> ContractSpec:
     return ContractSpec(
         name="ok_psum",
@@ -325,5 +344,6 @@ def get_contracts():
         _defused(),
         _serve_chatty(),
         _serve_f32_kv(),
+        _adaptive_fat_wire(),
         _ok_psum(),
     )

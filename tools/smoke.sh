@@ -2,7 +2,9 @@
 # One-shot smoke of the full product surface on a virtual 8-device CPU mesh
 # (no TPU needed). Exercises: both static-analysis gates (pslint source
 # gate, pscheck jaxpr contract gate), the multi-chip dryrun (all
-# parallelism axes), the PS CNN trainer + evaluator, the flat-state
+# parallelism axes), the PS CNN trainer + evaluator, the elasticity
+# drill (SIGTERM on 8 workers -> resume-reshape on 4 with an adaptive
+# mask under a straggler storm), the flat-state
 # default (int8 + EF + guard NaN-inject), the LM trainer on tp with
 # vocab-parallel embedding + the LM evaluator with KV-cache sampling,
 # the serving engine under open-loop traffic with one hot checkpoint
@@ -55,6 +57,42 @@ run python -m ps_pytorch_tpu.cli.train \
     --train-dir "$TMP/chaos"
 test -f "$TMP/chaos/model_step_6.corrupt" \
     || { echo "chaos smoke: corrupt checkpoint was not quarantined"; exit 1; }
+
+# elasticity leg (ARCHITECTURE §7f): a ZeRO-1 run SIGTERMs itself at
+# step 3 on the 8-worker mesh (graceful stop + checkpoint + elastic.json
+# manifest); the --resume run SHRINKS to a 4-worker mesh — the elastic
+# reshape re-carves params/moments bit-exactly — and rides the adaptive
+# aggregation mask through an injected straggler storm, which must drop
+# the mask count within one window (a mask_adapt event) while the step
+# numbering continues from the checkpoint (loss continuity, no restart)
+run python -m ps_pytorch_tpu.cli.train \
+    --network LeNet --dataset MNIST --num-workers 8 --batch-size 8 \
+    --opt-placement sharded --max-steps 30 --eval-freq 100 \
+    --log-interval 1 --fault-plan '{"sigterm": 3}' \
+    --train-dir "$TMP/elastic"
+test -f "$TMP/elastic/elastic.json" \
+    || { echo "elastic smoke: geometry manifest was not written"; exit 1; }
+run python -m ps_pytorch_tpu.cli.train \
+    --network LeNet --dataset MNIST --num-workers 4 --batch-size 8 \
+    --opt-placement sharded --max-steps 6 --eval-freq 100 \
+    --log-interval 1 --resume --train-dir "$TMP/elastic" \
+    --num-aggregate-min 2 --num-aggregate-max 4 --adapt-window 2 \
+    --mode kill --kill-threshold 0.75 \
+    --fault-plan '{"slow_steps": [5], "slow_s": 1.5}' \
+    --metrics-file "$TMP/elastic_resume.jsonl"
+run python - "$TMP/elastic_resume.jsonl" <<'PYEOF'
+import json, math, sys
+events = [json.loads(l) for l in open(sys.argv[1])]
+kinds = [e["kind"] for e in events]
+assert "resume_reshape" in kinds, kinds
+trains = [e for e in events if e["kind"] == "train"]
+assert trains and trains[0]["step"] == 4, trains[:1]   # continued, not restarted
+assert all(math.isfinite(e["loss"]) for e in trains), trains
+adapt = [e for e in events if e["kind"] == "mask_adapt"]
+assert adapt and adapt[0]["from"] == 4 and adapt[0]["to"] == 3, adapt
+print("elastic smoke: 8->4 reshape ok, mask %d->%d under storm, loss %.3f"
+      % (adapt[0]["from"], adapt[0]["to"], trains[-1]["loss"]))
+PYEOF
 
 # flat-state leg (ARCHITECTURE §6f, the default --state-layout): int8
 # wire + error feedback + a NaN gradient at step 3 — the guard must
