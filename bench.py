@@ -258,6 +258,8 @@ def _bench_serve() -> tuple:
         max_seq_len=t_prompt + n_new,
         compute_dtype=dt,
     )
+    from ps_pytorch_tpu.obs import Tracer, summarize_spans
+
     params = init_transformer(cfg, jax.random.key(0))
     serve = ServeConfig(
         slots=_srv_env("SLOTS"),
@@ -265,8 +267,12 @@ def _bench_serve() -> tuple:
         max_prompt_len=t_prompt,
         kv_int8=os.environ.get("BENCH_SRV_INT8KV") == "1",
     )
-    engine = ServingEngine(cfg, params, serve)
+    # in-memory tracer (no file): the drained spans become the record's
+    # per-phase breakdown
+    tracer = Tracer("bench_serve")
+    engine = ServingEngine(cfg, params, serve, tracer=tracer)
     engine.warmup()
+    tracer.drain()  # compile-warmup spans are not the measurement
     try:
         from ps_pytorch_tpu.check.opcount import hlo_op_count
 
@@ -284,7 +290,7 @@ def _bench_serve() -> tuple:
         seed=0,
     )
     summary = run_open_loop(engine, make_requests(tc))
-    return summary, hlo_ops
+    return summary, hlo_ops, summarize_spans(tracer.drain())
 
 
 def _serve_contract_entry():
@@ -734,6 +740,27 @@ def _validate_env() -> None:
         )
 
 
+def _run_info(n_devices, device_kind) -> dict:
+    """The self-describing run block every bench record carries (obs/
+    schema.py): run id + schema version + the measured geometry, so a
+    BENCH_* artifact is interpretable without the env that produced it."""
+    try:
+        from ps_pytorch_tpu.obs import SCHEMA_VERSION, new_run_id
+
+        rid, ver = new_run_id(), SCHEMA_VERSION
+    except Exception:  # error-record path on a broken env: stay emittable
+        rid, ver = None, None
+    return {
+        "run_id": rid,
+        "schema_version": ver,
+        "geometry": {
+            "workload": os.environ.get("BENCH_WORKLOAD", "lenet"),
+            "devices": n_devices,
+            "device_kind": str(device_kind) if device_kind else None,
+        },
+    }
+
+
 def _success_metric() -> str:
     """The metric key the CURRENT env's success record would carry (no
     _cpu_fallback suffix) — the single source for error records and
@@ -814,10 +841,19 @@ def main() -> None:
         os.environ["BENCH_CHAIN"] = "10"
     if name == "lm":
         steps = int(os.environ.get("BENCH_STEPS", 20))
+        leg_t0 = time.perf_counter()
         (tokens_per_sec, loss, elapsed, flops, lm_dev, steps,
          chain_used, hlo_ops) = _bench_lm(steps)
+        leg_wall = time.perf_counter() - leg_t0
         assert np.isfinite(loss), f"non-finite loss {loss}"
         rec = {
+            "run": _run_info(lm_dev, device_kind),
+            # where the leg's walltime went: everything outside the
+            # measured window is setup + compile
+            "phases": {
+                "setup_compile_s": round(max(leg_wall - elapsed, 0.0), 3),
+                "measure_s": round(elapsed, 3),
+            },
             "metric": _success_metric() + suffix,
             "value": round(tokens_per_sec, 1),
             "unit": "tokens/sec",
@@ -843,8 +879,15 @@ def main() -> None:
         return
     if name == "decode":
         steps = int(os.environ.get("BENCH_STEPS", 10))
+        leg_t0 = time.perf_counter()
         tokens_per_sec, elapsed, dec_hlo_ops = _bench_decode(steps)
+        leg_wall = time.perf_counter() - leg_t0
         rec = {
+            "run": _run_info(1, device_kind),
+            "phases": {
+                "setup_compile_s": round(max(leg_wall - elapsed, 0.0), 3),
+                "measure_s": round(elapsed, 3),
+            },
             "metric": _success_metric() + suffix,
             "value": round(tokens_per_sec, 1),
             "unit": "tokens/sec",
@@ -866,8 +909,13 @@ def main() -> None:
         )
         return
     if name == "serve":
-        summary, srv_hlo_ops = _bench_serve()
+        summary, srv_hlo_ops, srv_phases = _bench_serve()
         rec = {
+            "run": _run_info(1, device_kind),
+            # per-phase p50/p99 from the engine's own span tracer: where
+            # a serve tick's walltime goes (dispatch vs token fetch vs
+            # admission prefill)
+            "phases": srv_phases,
             "metric": _success_metric() + suffix,
             "value": summary["tokens_per_sec"],
             "unit": "tokens/sec",
@@ -885,6 +933,11 @@ def main() -> None:
                     "requests_completed", "new_tokens", "elapsed_s",
                     "p50_token_latency_s", "p99_token_latency_s",
                     "p50_ttft_s", "p99_ttft_s",
+                    # TTFT decomposition: queue + prefill == TTFT per
+                    # request (serve/scheduler.Completion)
+                    "p50_queue_s", "p99_queue_s",
+                    "p50_prefill_s", "p99_prefill_s",
+                    "p50_decode_s", "p99_decode_s",
                 )
             },
         }
@@ -943,9 +996,11 @@ def main() -> None:
         # computation retires, silently turning the benchmark into a
         # dispatch-rate measurement — and the loss alone does not
         # serialize the optimizer update, which feeds only the params.
+        warm_t0 = time.perf_counter()
         for _ in range(2):
             state, metrics = step(state, sharded, key)
         host_sync(state.params, metrics)
+        warmup_s = time.perf_counter() - warm_t0
         flops, hlo_ops = _step_cost(step, state, sharded, key)
         update_ops = None
         if probe_update_path:
@@ -979,6 +1034,11 @@ def main() -> None:
             "bucket_bytes": bucket_bytes,
             "state_layout": state_layout,
             "hlo_op_count": hlo_ops,
+            # leg walltime breakdown: compile+settle vs measured window
+            "phases": {
+                "warmup_s": round(warmup_s, 3),
+                "measure_s": round(elapsed, 3),
+            },
             # comm shape from the committed pscheck artifact, so the
             # perf trajectory records the wire, not just walltime
             "comm": _comm_contract_entry(name, compress, bucket_bytes),
@@ -997,6 +1057,8 @@ def main() -> None:
         sub_bkt, loss, elapsed, steps, flops, k = run_variant(ab_bb)
         images_per_sec = sub_bkt["images_per_sec"]
         rec = {
+            "run": _run_info(n_dev, device_kind),
+            "phases": sub_bkt["phases"],
             "metric": _success_metric() + suffix,
             "value": images_per_sec,
             "unit": "images/sec",
@@ -1032,6 +1094,8 @@ def main() -> None:
         )
         images_per_sec = sub_flat["images_per_sec"]
         rec = {
+            "run": _run_info(n_dev, device_kind),
+            "phases": sub_flat["phases"],
             "metric": _success_metric() + suffix,
             "value": images_per_sec,
             "unit": "images/sec",
@@ -1066,6 +1130,8 @@ def main() -> None:
         )
         images_per_sec = sub["images_per_sec"]
         rec = {
+            "run": _run_info(n_dev, device_kind),
+            "phases": sub["phases"],
             "metric": _success_metric() + suffix,
             "value": images_per_sec,
             "unit": "images/sec",
@@ -1123,9 +1189,13 @@ def _emit_error_record(err: str) -> None:
     if os.environ.get("BENCH_CPU_FALLBACK") == "1":
         metric += "_cpu_fallback"  # keep error keys aligned with success keys
     rec = {
+        "run": _run_info(None, None),
         "metric": metric,
         "value": None,
-        "unit": "tokens/sec" if name in ("lm", "decode") else "images/sec",
+        "unit": (
+            "tokens/sec" if name in ("lm", "decode", "serve")
+            else "images/sec"
+        ),
         "vs_baseline": None,
         "error": err[:500],
         "timestamp": _utc_now(),

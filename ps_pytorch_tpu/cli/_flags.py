@@ -59,7 +59,21 @@ def add_train_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                         choices=("float32", "bfloat16"),
                         help="compute dtype (bfloat16 = MXU-native; params stay f32)")
     parser.add_argument("--profile-dir", type=str, default=None,
-                        help="write a jax.profiler trace of ~10 steps here")
+                        help="write a jax.profiler trace of a bounded "
+                             "step window here (see --profile-start/"
+                             "--profile-steps)")
+    parser.add_argument("--profile-start", type=int, default=None,
+                        help="first profiled step (default: one warmup "
+                             "step after the run's first step, so "
+                             "compilation stays out of the capture)")
+    parser.add_argument("--profile-steps", type=int, default=d.profile_steps,
+                        help="profiled window length in steps: captures "
+                             "[start, start+N)")
+    parser.add_argument("--trace", type=str, default=None, metavar="DIR",
+                        help="host-phase span tracing (obs/trace.py): "
+                             "write this process's span stream "
+                             "(trace_train_p<i>.jsonl) into DIR; merge "
+                             "and summarize with tools/trace_report.py")
     parser.add_argument("--remat", action="store_true",
                         help="rematerialize ResNet blocks in backward (saves memory)")
     parser.add_argument("--metrics-file", type=str, default=None,
@@ -217,6 +231,9 @@ def train_config_from(args: argparse.Namespace) -> TrainConfig:
         shard_mode=args.shard_mode,
         dtype=args.dtype,
         profile_dir=args.profile_dir,
+        profile_start=args.profile_start,
+        profile_steps=args.profile_steps,
+        trace_dir=args.trace,
         remat=args.remat,
         metrics_file=args.metrics_file,
         straggler_threshold_s=(

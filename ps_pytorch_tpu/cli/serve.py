@@ -74,6 +74,10 @@ def main(argv=None) -> dict:
                         "percentiles then include XLA compilation)")
     p.add_argument("--summary-file", type=str, default=None,
                    help="also write the JSON summary here")
+    p.add_argument("--trace", type=str, default=None, metavar="DIR",
+                   help="host-phase span tracing (obs/trace.py): write "
+                        "the serve span stream (trace_serve_p0.jsonl) "
+                        "into DIR; merge with tools/trace_report.py")
     args = p.parse_args(argv)
 
     import jax.numpy as jnp
@@ -114,9 +118,26 @@ def main(argv=None) -> dict:
         from ..parallel.mesh import make_mesh
 
         mesh = make_mesh(num_workers=args.num_workers)
+    tracer = None
+    if args.trace:
+        import os
+
+        from ..obs import Tracer
+
+        tracer = Tracer(
+            "serve",
+            path=os.path.join(args.trace, "trace_serve_p0.jsonl"),
+            annotate=True,
+            geometry={
+                "slots": serve_cfg.slots,
+                "max_len": serve_cfg.max_len,
+                "kv_int8": serve_cfg.kv_int8,
+                "num_workers": args.num_workers or 1,
+            },
+        )
     engine = ServingEngine(
         cfg, params, serve_cfg, mesh=mesh,
-        model_dir=args.model_dir, step=step,
+        model_dir=args.model_dir, step=step, tracer=tracer,
     )
     logger.info(
         "serving step %d: %d slots x %d positions%s%s",
@@ -151,9 +172,16 @@ def main(argv=None) -> dict:
     )
     if not args.no_warmup:
         engine.warmup()
-    summary = run_open_loop(
-        engine, requests, poll_interval_s=args.poll_interval
-    )
+    try:
+        summary = run_open_loop(
+            engine, requests, poll_interval_s=args.poll_interval
+        )
+    finally:
+        if tracer is not None:
+            # trailing partial window — and on an error/interrupt the
+            # spans served so far (plus the header) still land on disk,
+            # mirroring the trainer's finally-flush
+            tracer.flush()
     line = json.dumps(summary, sort_keys=True)
     print(line)
     if args.summary_file:

@@ -379,6 +379,20 @@ def main(argv=None) -> dict:
         "LM %dx d%d h%d (%d params), seq %d, %s",
         args.depth, args.dim, args.heads, n_params, args.seq_len, layout,
     )
+    from ..obs import run_header
+
+    append_metrics_line(
+        args.metrics_file,
+        run_header(
+            "train_lm",
+            geometry={
+                "parallelism": args.parallelism,
+                "dim": args.dim, "depth": args.depth,
+                "heads": args.heads, "seq_len": args.seq_len,
+                "params": n_params,
+            },
+        ),
+    )
 
     def save_lm_checkpoint(step_no):
         if args.train_dir is None:

@@ -148,7 +148,7 @@ def shard_for_worker(
 
 
 def prefetch_to_device(
-    iterator: Iterator[dict], size: int = 2, device=None
+    iterator: Iterator[dict], size: int = 2, device=None, tracer=None
 ) -> Iterator[dict]:
     """Keep `size` batches ahead on device (reference's pin-memory analogue).
 
@@ -160,15 +160,22 @@ def prefetch_to_device(
     directly instead of re-laying-out a replicated batch inside the
     step. A PartitionSpec shorter than a leaf's rank shards the leading
     (batch) dim and replicates the rest, which fits both the [B,H,W,C]
-    images and the [B] labels."""
+    images and the [B] labels.
+
+    ``tracer`` (obs/trace.py) wraps each device_put dispatch in an
+    ``h2d`` span — dispatch walltime, not transfer completion: the
+    transfer itself overlaps compute, which is the point of prefetching."""
     queue = collections.deque()
+    if tracer is None:
+        from ..obs import NULL_TRACER as tracer  # noqa: N811 - constant
 
     def enqueue(n):
         for _ in range(n):
             batch = next(iterator, None)
             if batch is None:
                 return
-            queue.append(jax.device_put(batch, device))
+            with tracer.span("h2d"):
+                queue.append(jax.device_put(batch, device))
 
     enqueue(size)
     while queue:

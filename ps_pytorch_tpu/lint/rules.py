@@ -423,6 +423,14 @@ class HostSyncRule:
     # serve engine, not any future module that happens to be named
     # engine.py); bare names match by basename
     HOT_MODULES = {"trainer.py", "serve/engine.py"}
+    # directory trees where EVERY function is a hot-path loop body by
+    # contract, scanned in STRICT mode: the observability layer runs
+    # inside the training/serving tick, so any host sync it introduces
+    # perturbs the run it measures. Strict mode additionally flags
+    # jax.block_until_ready — elsewhere the blessed barrier primitive,
+    # here a new sync the traced run would not otherwise have (the one
+    # deliberate profiler-stop barrier carries `# psl: sync-ok`).
+    HOT_TREES = ("ps_pytorch_tpu/obs/",)
     STEP_CALL_RE = re.compile(r"(^|[._])(train_|eval_)?step(_fn)?$")
     # a per-step entry point (the serving engine's tick()) IS a loop
     # body by contract — its caller invokes it once per decode step —
@@ -443,9 +451,14 @@ class HostSyncRule:
                 return True
         return False
 
+    def _in_hot_tree(self, path: str) -> bool:
+        norm = "/" + path.replace(os.sep, "/")
+        return any("/" + tree in norm for tree in self.HOT_TREES)
+
     def check(self, tree: ast.AST, path: str, axes: Dict[str, str],
               donors: Dict[str, Tuple[int, ...]]) -> Iterable[Finding3]:
-        if not self._is_hot(path):
+        strict = self._in_hot_tree(path)
+        if not strict and not self._is_hot(path):
             return
         for node in ast.walk(tree):
             if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
@@ -453,15 +466,17 @@ class HostSyncRule:
                 # periodic `metrics = jax.device_get(metrics)` inside a
                 # log window untaints only from that point on — per-step
                 # syncs on the same name BEFORE the fetch still flag
-                depth0 = 1 if self.HOT_FN_RE.match(node.name) else 0
+                depth0 = (
+                    1 if strict or self.HOT_FN_RE.match(node.name) else 0
+                )
                 yield from self._scan_block(
                     node.body, tainted=set(), loop_depth=depth0,
-                    flagged=set()
+                    flagged=set(), strict=strict,
                 )
 
     def _flag_stmt(
         self, stmt: ast.stmt, tainted: Set[str], loop_depth: int,
-        flagged: Set[int],
+        flagged: Set[int], strict: bool = False,
     ) -> Iterator[Finding3]:
         if loop_depth == 0:
             return
@@ -509,6 +524,14 @@ class HostSyncRule:
                     "np.asarray on a device value in a hot-path loop "
                     "copies to host synchronously every step"
                 )
+            elif strict and tail == "block_until_ready":
+                msg = (
+                    "block_until_ready in observability code adds a host "
+                    "sync the traced run would not otherwise pay — the "
+                    "tracer must reuse the driver's existing per-window "
+                    "sync points (a deliberate once-per-capture profiler "
+                    "barrier may carry `# psl: sync-ok`)"
+                )
             if msg is not None:
                 flagged.add(id(n))
                 yield (n.lineno, n.col_offset, msg)
@@ -539,7 +562,7 @@ class HostSyncRule:
 
     def _scan_block(
         self, stmts: List[ast.stmt], tainted: Set[str], loop_depth: int,
-        flagged: Set[int],
+        flagged: Set[int], strict: bool = False,
     ) -> Iterator[Finding3]:
         for stmt in stmts:
             if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
@@ -559,7 +582,7 @@ class HostSyncRule:
                         )
                         yield from self._flag_stmt(
                             ast.Expr(value=header), tainted, header_depth,
-                            flagged,
+                            flagged, strict,
                         )
                 bodies = _compound_bodies(stmt)
                 if isinstance(stmt, (ast.For, ast.AsyncFor, ast.While)):
@@ -569,21 +592,23 @@ class HostSyncRule:
                     for _ in range(2):
                         for b in bodies:
                             yield from self._scan_block(
-                                b, tainted, loop_depth + 1, flagged
+                                b, tainted, loop_depth + 1, flagged, strict
                             )
                     if isinstance(stmt, ast.While):
                         # back-edge: the test re-runs with the body's taint
                         yield from self._flag_stmt(
                             ast.Expr(value=stmt.test), tainted,
-                            loop_depth + 1, flagged,
+                            loop_depth + 1, flagged, strict,
                         )
                 else:
                     for b in bodies:
                         yield from self._scan_block(
-                            b, tainted, loop_depth, flagged
+                            b, tainted, loop_depth, flagged, strict
                         )
             else:
-                yield from self._flag_stmt(stmt, tainted, loop_depth, flagged)
+                yield from self._flag_stmt(
+                    stmt, tainted, loop_depth, flagged, strict
+                )
                 self._apply_taint(stmt, tainted)
 
 

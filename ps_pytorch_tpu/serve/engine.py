@@ -62,6 +62,7 @@ from ..parallel.buckets import (
     tree_layout,
     tree_view,
 )
+from ..obs import NULL_TRACER
 from ..parallel.mesh import pool_sharding, replicated_sharding
 from ..utils import get_logger
 from .kv import attend_pool, init_kv_pool, write_slot, write_token
@@ -168,6 +169,7 @@ class ServingEngine:
         model_dir: Optional[str] = None,
         step: Optional[int] = None,
         clock=None,
+        tracer=None,
     ):
         if not cfg.causal:
             raise ValueError("serving decode is autoregressive: cfg.causal")
@@ -191,6 +193,12 @@ class ServingEngine:
         # so arrival times and emission times share one timeline; tests
         # inject a virtual clock for determinism.
         self.clock = clock or time.perf_counter
+        # span tracer (obs/trace.py): serve-tick phases + per-request
+        # lifecycle spans. NULL_TRACER (the default) is inert — tick()
+        # stays at exactly one host sync either way (PSL004 pins it).
+        # Spans run on the tracer's REAL clock, independent of the
+        # latency clock above (which tests inject/virtualize).
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         self.scheduler = SlotScheduler(
             serve.slots, serve.max_len, serve.max_prompt_len
         )
@@ -230,6 +238,11 @@ class ServingEngine:
         self._dirty = True
         self._pending: Optional[Tuple[int, np.ndarray]] = None
         self.rollovers: List[Dict[str, Any]] = []
+        self._tick_no = 0
+        # per-slot admission instant on the TRACER clock (request
+        # lifecycle spans) and the open drain's start, if any
+        self._admit_tr_t: Dict[int, float] = {}
+        self._drain_tr_t0: Optional[float] = None
 
     # ------------------------------------------------------- construction
     @classmethod
@@ -240,6 +253,7 @@ class ServingEngine:
         step: Optional[int] = None,
         mesh=None,
         compute_dtype=None,
+        tracer=None,
     ) -> "ServingEngine":
         """Load a cli/train_lm checkpoint (dense LMs; the evaluator's
         scheme-agnostic raw layout) into a serving engine."""
@@ -252,7 +266,7 @@ class ServingEngine:
             raw = load_checkpoint_raw(model_dir, step)
         cfg, params = checkpoint_model(raw, compute_dtype)
         return cls(cfg, params, serve, mesh=mesh, model_dir=model_dir,
-                   step=step)
+                   step=step, tracer=tracer)
 
     def _place_flat(self, flat: np.ndarray) -> jax.Array:
         if self.mesh is not None:
@@ -281,6 +295,8 @@ class ServingEngine:
                 f"geometry than the serving model — rollover would "
                 f"require a recompile, refusing"
             )
+        if self._drain_tr_t0 is None:
+            self._drain_tr_t0 = self.tracer.now()
         self._pending = (
             new_step, _flat_params(self._layout, self._plan, params)
         )
@@ -293,11 +309,25 @@ class ServingEngine:
     def _swap_pending(self, now_s: float) -> None:
         new_step, flat = self._pending
         self._pending = None
-        self._params = FlatVector(
-            flat=self._place_flat(flat),
-            layout=self._layout,
-            plan=self._plan,
-        )
+        if self._drain_tr_t0 is not None:
+            # the drain interval spans ticks: staged in one poll, swapped
+            # when the last in-flight request finished — record it as one
+            # explicit span so the timeline shows WHY admission paused
+            self.tracer.add(
+                "rollover_drain", self._drain_tr_t0,
+                self.tracer.now() - self._drain_tr_t0, cat="serve",
+                from_step=self.step, to_step=new_step,
+            )
+            self._drain_tr_t0 = None
+        with self.tracer.span(
+            "rollover_swap", cat="serve",
+            from_step=self.step, to_step=new_step,
+        ):
+            self._params = FlatVector(
+                flat=self._place_flat(flat),
+                layout=self._layout,
+                plan=self._plan,
+            )
         self.rollovers.append(
             {"from_step": self.step, "to_step": new_step, "at_s": now_s}
         )
@@ -316,6 +346,8 @@ class ServingEngine:
     def tick(self) -> List[Completion]:
         """One scheduler round: swap-if-drained, admit, one decode step,
         record/evict. Returns the completions that finished this tick."""
+        self._tick_no += 1
+        tr = self.tracer
         now_s = self.clock()
         if self._pending is not None and self.scheduler.n_inflight == 0:
             self._swap_pending(now_s)
@@ -325,51 +357,78 @@ class ServingEngine:
         if self.scheduler.n_inflight == 0:
             return []
 
-        if self._dirty or self._dev is None:
-            self._dev = (
-                jnp.asarray(self._tok), jnp.asarray(self._pos),
-                jnp.asarray(self._active),
+        with tr.span("decode_dispatch", cat="serve", tick=self._tick_no):
+            if self._dirty or self._dev is None:
+                self._dev = (
+                    jnp.asarray(self._tok), jnp.asarray(self._pos),
+                    jnp.asarray(self._active),
+                )
+                self._dirty = False
+            tok_d, pos_d, act_d = self._dev
+            self._pool, nxt, new_pos = self._decode(
+                self._params, self._pool, tok_d, pos_d, act_d
             )
-            self._dirty = False
-        tok_d, pos_d, act_d = self._dev
-        self._pool, nxt, new_pos = self._decode(
-            self._params, self._pool, tok_d, pos_d, act_d
-        )
-        self._dev = (nxt, new_pos, act_d)
+            self._dev = (nxt, new_pos, act_d)
         # THE per-tick host sync: the scheduler cannot admit/evict
         # without this step's tokens — one fused [slots] fetch, not a
         # per-request read
-        tokens = np.asarray(jax.device_get(nxt))  # psl: sync-ok
+        with tr.span("token_fetch", cat="serve", tick=self._tick_no):
+            tokens = np.asarray(jax.device_get(nxt))  # psl: sync-ok
         # latency is measured at emission (after the fetch retires), not
         # at tick entry — the fetch IS the serving latency's device half
         emit_s = self.clock()
 
         done: List[Completion] = []
-        for slot in list(self.scheduler.active_slots):
-            token = int(tokens[slot])
-            self._tok[slot] = token
-            self._pos[slot] += 1
-            if self.scheduler.record_token(slot, token, emit_s):
-                self._active[slot] = False
-                self._dirty = True  # next tick rebuilds the device triple
-                done.append(
-                    self.scheduler.evict(slot, emit_s, weights_step=self.step)
-                )
+        with tr.span("evict", cat="serve", tick=self._tick_no):
+            for slot in list(self.scheduler.active_slots):
+                token = int(tokens[slot])
+                self._tok[slot] = token
+                self._pos[slot] += 1
+                if self.scheduler.record_token(slot, token, emit_s):
+                    self._active[slot] = False
+                    self._dirty = True  # next tick rebuilds the triple
+                    c = self.scheduler.evict(
+                        slot, emit_s, weights_step=self.step
+                    )
+                    t0 = self._admit_tr_t.pop(slot, None)
+                    if t0 is not None:
+                        # request lifecycle (admission -> finish on the
+                        # tracer clock); the queue component — arrival ->
+                        # admission, measured on the latency clock —
+                        # rides as an attribute
+                        tr.add(
+                            "request", t0, tr.now() - t0, cat="request",
+                            slot=slot,
+                            rid=c.rid, queue_s=round(c.queue_s, 6),
+                            prefill_s=round(c.prefill_s, 6),
+                            decode_s=round(c.decode_s, 6),
+                            new_tokens=len(c.tokens),
+                            weights_step=c.weights_step,
+                        )
+                    done.append(c)
+        if tr.enabled and self._tick_no % 256 == 0:
+            # the serve loop's "log window": bounded-latency flushes off
+            # the ring so a long-lived server never loses old spans
+            tr.flush()
         return done
 
     def _admit_slot(self, slot: int, req: Request) -> None:
-        plen = int(req.prompt.shape[0])
-        if plen > 1:
-            padded = np.zeros((self.serve.max_prompt_len,), np.int32)
-            padded[:plen] = req.prompt
-            self._pool = self._prefill(
-                self._params, self._pool, jnp.asarray(padded),
-                np.int32(slot),
-            )
-        self._tok[slot] = int(req.prompt[plen - 1])
-        self._pos[slot] = plen - 1
-        self._active[slot] = True
-        self._dirty = True  # next tick rebuilds the device triple
+        with self.tracer.span(
+            "admit_prefill", cat="serve", slot=slot, rid=req.rid
+        ):
+            self._admit_tr_t[slot] = self.tracer.now()
+            plen = int(req.prompt.shape[0])
+            if plen > 1:
+                padded = np.zeros((self.serve.max_prompt_len,), np.int32)
+                padded[:plen] = req.prompt
+                self._pool = self._prefill(
+                    self._params, self._pool, jnp.asarray(padded),
+                    np.int32(slot),
+                )
+            self._tok[slot] = int(req.prompt[plen - 1])
+            self._pos[slot] = plen - 1
+            self._active[slot] = True
+            self._dirty = True  # next tick rebuilds the device triple
 
     # ------------------------------------------------------- conveniences
     def compiled_decode_text(self) -> str:

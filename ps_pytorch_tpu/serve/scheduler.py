@@ -58,6 +58,17 @@ class Completion:
     # the checkpoint step whose weights generated this completion (the
     # drain-then-swap rollover rule means it is ONE step, never a mix)
     weights_step: Optional[int] = None
+    # TTFT decomposition (ARCHITECTURE §7g): latencies_s[0] ==
+    # queue_s + prefill_s by construction.
+    #   queue_s   arrival -> admission (0.0 for closed-loop requests,
+    #             whose TTFT base IS the admission instant)
+    #   prefill_s admission -> first token emitted (covers the padded
+    #             prefill AND the first decode step — the engine fuses
+    #             them into one tick)
+    #   decode_s  first token -> last token (the inter-token tail)
+    queue_s: float = 0.0
+    prefill_s: float = 0.0
+    decode_s: float = 0.0
 
 
 @dataclasses.dataclass
@@ -67,6 +78,8 @@ class _InFlight:
     tokens: List[int]
     latencies_s: List[float]
     last_token_s: float          # arrival at admission; then last emit
+    admitted_s: float = 0.0      # admission instant (scheduler clock)
+    first_token_s: Optional[float] = None
 
 
 class SlotScheduler:
@@ -127,6 +140,7 @@ class SlotScheduler:
                 last_token_s=(
                     req.arrival_s if req.arrival_s is not None else now_s
                 ),
+                admitted_s=now_s,
             )
             admitted.append((slot, req))
         return admitted
@@ -136,6 +150,8 @@ class SlotScheduler:
         """Append one generated token; True when the request just hit its
         new-token budget (caller evicts)."""
         inf = self._inflight[slot]
+        if not inf.tokens:
+            inf.first_token_s = now_s
         inf.tokens.append(int(token))
         inf.latencies_s.append(max(now_s - inf.last_token_s, 0.0))
         inf.last_token_s = now_s
@@ -146,6 +162,22 @@ class SlotScheduler:
         inf = self._inflight.pop(slot)
         self._free.append(slot)
         self._free.sort(reverse=True)
+        # TTFT decomposition on the scheduler's own clock: the same
+        # instants the latencies were measured with, so the components
+        # sum exactly (queue + prefill == latencies_s[0]). The TTFT base
+        # is max(admission, arrival): an injected-clock fast-forward
+        # (traffic.run_open_loop) can admit BEFORE the nominal arrival,
+        # and prefill must then count from the arrival the first-token
+        # latency counts from, or the components would sum past it.
+        arrival = (
+            inf.request.arrival_s
+            if inf.request.arrival_s is not None
+            else inf.admitted_s
+        )
+        first = (
+            inf.first_token_s if inf.first_token_s is not None else now_s
+        )
+        base = max(inf.admitted_s, arrival)
         return Completion(
             rid=inf.request.rid,
             prompt=inf.request.prompt,
@@ -153,6 +185,9 @@ class SlotScheduler:
             latencies_s=inf.latencies_s,
             finished_s=now_s,
             weights_step=weights_step,
+            queue_s=max(inf.admitted_s - arrival, 0.0),
+            prefill_s=max(first - base, 0.0),
+            decode_s=max(inf.last_token_s - first, 0.0),
         )
 
     # ----------------------------------------------------------- queries

@@ -151,6 +151,14 @@ def summarize(completions: Sequence[Completion], elapsed_s: float,
         "p50_ttft_s": _pct(ttft, 50),
         "p99_ttft_s": _pct(ttft, 99),
     }
+    # TTFT decomposition (scheduler.Completion): queue + prefill == TTFT
+    # per request, so a fat TTFT tail is attributable — queueing delay
+    # (admission pressure, rollover drains) vs prefill cost. decode_s is
+    # the whole inter-token tail of one request, not a per-token gap.
+    for comp in ("queue_s", "prefill_s", "decode_s"):
+        xs = np.asarray([getattr(c, comp) for c in completions], np.float64)
+        out[f"p50_{comp}"] = _pct(xs, 50)
+        out[f"p99_{comp}"] = _pct(xs, 99)
     if engine is not None:
         out["weights_step"] = engine.step
         out["rollovers"] = list(engine.rollovers)

@@ -48,6 +48,14 @@ from .parallel import (
     make_ps_train_step,
     shard_state,
 )
+from .obs import (
+    NULL_TRACER,
+    ProfileWindow,
+    Tracer,
+    new_run_id,
+    run_header,
+    validate_event,
+)
 from .resilience import AdaptiveMaskController, resolve_fault_plan
 from .resilience import elastic
 from .utils import PhaseTimer, format_eval_line, format_iter_line, get_logger
@@ -58,9 +66,18 @@ logger = get_logger()
 def append_metrics_line(path: Optional[str], record: dict) -> None:
     """Structured metrics sink (one JSON object per line). The reference
     has only parseable log text (SURVEY.md section 5 'no TensorBoard/CSV');
-    this is the machine-readable channel next to it."""
+    this is the machine-readable channel next to it.
+
+    THE write choke point for every event emitter: each record is
+    validated/normalized against the observability event registry
+    (obs/schema.py — unknown kinds and missing required fields raise,
+    declared counter fields are coerced to int) and stamped with a
+    ``t_wall`` wall-clock second, so the JSONL stream merges onto the
+    span-trace timeline (tools/trace_report.py overlays)."""
     if not path:
         return
+    record = validate_event(record)
+    record.setdefault("t_wall", round(time.time(), 6))
     os.makedirs(os.path.dirname(path) or ".", exist_ok=True)
     with open(path, "a") as f:
         f.write(json.dumps(record) + "\n")
@@ -109,7 +126,18 @@ class TrainConfig:
     dtype: str = "float32"  # compute dtype: float32 | bfloat16 (MXU-native)
     remat: bool = False  # per-block activation rematerialization (ResNets)
     metrics_file: Optional[str] = None  # append one JSON line per logged step
-    profile_dir: Optional[str] = None  # jax.profiler trace output (eval_freq window)
+    # span tracing (obs/trace.py, --trace): write this process's host-
+    # phase span stream (trace_train_p<i>.jsonl) into this directory.
+    # None = the NULL tracer: zero overhead, zero host syncs (pslint
+    # PSL004 patrols the instrumented paths). tools/trace_report.py
+    # merges per-process files and summarizes p50/p99 per phase.
+    trace_dir: Optional[str] = None
+    profile_dir: Optional[str] = None  # jax.profiler trace output
+    # bounded profiler capture window [profile_start, profile_start +
+    # profile_steps): None = auto (one warmup step after the run's first
+    # step, so compilation stays out of the capture)
+    profile_start: Optional[int] = None
+    profile_steps: int = 10
     # straggler watchdog (reference --kill-threshold, distributed_nn.py:52:
     # there it was meant to kill slow workers; under SPMD there is nothing
     # to kill, so the live semantics are detection + structured warning)
@@ -239,6 +267,25 @@ class Trainer:
             event_sink=lambda rec: append_metrics_line(tcfg.metrics_file, rec),
             faults=self.faults,
         )
+        # one run id ties this run's streams together (metrics JSONL run
+        # header + the per-process span trace file)
+        self.run_id = new_run_id()
+        self.tracer = NULL_TRACER
+        if tcfg.trace_dir:
+            self.tracer = Tracer(
+                "train",
+                path=os.path.join(
+                    tcfg.trace_dir,
+                    f"trace_train_p{jax.process_index()}.jsonl",
+                ),
+                run_id=self.run_id,
+                pid=jax.process_index(),
+                # host spans double as jax.profiler.TraceAnnotation
+                # scopes, so a --profile-dir capture shows the named
+                # phases on the profiler timeline too
+                annotate=True,
+                geometry=self._geometry(),
+            )
         logger.info(
             "model %s (%d params), dataset %s%s, %d workers",
             tcfg.network,
@@ -255,6 +302,18 @@ class Trainer:
             " [synthetic]" if self.dataset.synthetic else "",
             pcfg.num_workers,
         )
+
+    def _geometry(self) -> dict:
+        """The run-header geometry block: enough to interpret a stream
+        without the CLI line that produced it."""
+        return {
+            "num_workers": self.pcfg.num_workers,
+            "network": self.tcfg.network,
+            "dataset": self.tcfg.dataset,
+            "opt_placement": self.pcfg.opt_placement,
+            "state_layout": self.pcfg.state_layout,
+            "processes": jax.process_count(),
+        }
 
     # ------------------------------------------------------------------ resume
     def try_resume(self) -> Optional[int]:
@@ -604,6 +663,15 @@ class Trainer:
         requested BEFORE the loop starts (signal during setup) is honored
         at the first step — never silently cleared."""
         t = self.tcfg
+        # the stream-opening run header: FIRST record, before resume can
+        # emit resume_reshape/ckpt_quarantined events into the file
+        append_metrics_line(
+            t.metrics_file,
+            run_header(
+                "train", run_id=self.run_id, geometry=self._geometry(),
+                pid=jax.process_index(),
+            ),
+        )
         if t.resume:
             self.try_resume()
         global_batch = t.batch_size * self.pcfg.num_workers
@@ -638,19 +706,29 @@ class Trainer:
         # sharded batch on device). Bound it independently of log_interval.
         unsynced, max_unsynced = 0, 32
         done = False
-        # profiler window: ~10 post-compile steps, parity role of the
-        # reference's per-phase wall spans but with real device timelines
-        # (SURVEY.md section 5 "tracing"; view with tensorboard/xprof)
-        steps_remaining = t.max_steps - step_no
-        if t.profile_dir and steps_remaining < 3:
+        # profiler window: profile_steps post-compile steps (obs/
+        # profiler.py), parity role of the reference's per-phase wall
+        # spans but with real device timelines (SURVEY.md section 5
+        # "tracing"; view with tensorboard/xprof)
+        pw = ProfileWindow(
+            t.profile_dir,
+            start_step=(
+                t.profile_start if t.profile_start is not None
+                else first_step + 1
+            ),
+            num_steps=t.profile_steps,
+        )
+        if t.profile_dir and (pw.start > t.max_steps or pw.stop <= first_step):
+            # the window misses this run's steps entirely — starts past
+            # max_steps, or (an explicit --profile-start on a resumed
+            # run) ended before the resume point. Say so rather than
+            # silently writing nothing.
             logger.info(
-                "profile-dir set but only %d step(s) will run; profiling "
-                "starts after 2 warmup steps — no trace will be written",
-                steps_remaining,
+                "profile-dir set but the capture window [%d, %d) misses "
+                "this run's steps [%d, %d] — no trace will be written",
+                pw.start, pw.stop, first_step, t.max_steps,
             )
-        profile_start = step_no + 2 if t.profile_dir else None
-        profile_stop = profile_start + 10 if t.profile_dir else None
-        profiling = False
+        tr = self.tracer
         last_saved = None
         try:
             for epoch in range(1, t.epochs + 1):
@@ -677,6 +755,7 @@ class Trainer:
                 prefetched = prefetch_to_device(
                     _host_batches(), size=2,
                     device=batch_sharding(self.mesh, self.pcfg),
+                    tracer=tr,  # h2d dispatch spans, nested under fetch
                 )
                 for batch_idx in range(steps_per_epoch):
                     if step_no >= t.max_steps:
@@ -684,28 +763,26 @@ class Trainer:
                         # is a no-op instead of overshooting max_steps
                         done = True
                         break
-                    if profile_start is not None and step_no + 1 == profile_start:
-                        jax.profiler.start_trace(t.profile_dir)
-                        profiling = True
-                    elif profiling and step_no + 1 == profile_stop:
-                        jax.block_until_ready(self.state.params)
-                        jax.profiler.stop_trace()
-                        profiling = False
+                    pw.before_step(step_no + 1, sync=self.state.params)
                     timer.reset()
-                    with timer.phase("fetch"):
+                    with timer.phase("fetch"), tr.span(
+                        "fetch", step=step_no + 1
+                    ):
                         sharded = next(prefetched)
                     with timer.phase("step"):
-                        if self._adaptive is not None:
-                            # the traced per-window count: same compiled
-                            # program for every value in the bounds
-                            self.state, metrics = self._train_step(
-                                self.state, sharded, self._key,
-                                np.int32(self._adaptive.count),
-                            )
-                        else:
-                            self.state, metrics = self._train_step(
-                                self.state, sharded, self._key
-                            )
+                        with tr.span("dispatch", step=step_no + 1):
+                            if self._adaptive is not None:
+                                # the traced per-window count: same
+                                # compiled program for every value in
+                                # the bounds
+                                self.state, metrics = self._train_step(
+                                    self.state, sharded, self._key,
+                                    np.int32(self._adaptive.count),
+                                )
+                            else:
+                                self.state, metrics = self._train_step(
+                                    self.state, sharded, self._key
+                                )
                         if self.faults is not None:
                             # injected host stall, inside the timed phase
                             # so the watchdog sees it as a real slow step
@@ -713,8 +790,11 @@ class Trainer:
                         if t.straggler_threshold_s is not None:
                             # the watchdog times real step walltime, not
                             # dispatch — an intentional per-step barrier,
-                            # only when the watchdog is armed
-                            jax.block_until_ready(metrics)
+                            # only when the watchdog is armed (the span
+                            # observes the EXISTING barrier; tracing off
+                            # or on, the sync set is identical)
+                            with tr.span("sync", step=step_no + 1):
+                                jax.block_until_ready(metrics)
                     step_no += 1
                     if self.faults is not None:
                         # injected preemption: SIGTERM ourselves at the
@@ -809,7 +889,8 @@ class Trainer:
                         # the Fetch/Forward fields remain raw host phase
                         # durations — with the watchdog disarmed, Forward
                         # is dispatch time, not compute.)
-                        metrics = jax.device_get(metrics)  # psl: sync-ok
+                        with tr.span("sync", step=step_no):
+                            metrics = jax.device_get(metrics)  # psl: sync-ok
                         unsynced = 0
                         step_time = (
                             time.perf_counter() - window_t0
@@ -843,7 +924,11 @@ class Trainer:
                         # AFTER the window's train record lands (unlike
                         # the backpressure block below) so an aborting
                         # window is still in the JSONL
-                        self._guard_check(metrics, step_no)
+                        with tr.span("guard", step=step_no):
+                            self._guard_check(metrics, step_no)
+                        # the per-window flush: span I/O lands where the
+                        # host already stalled on the device fetch above
+                        tr.flush()
                     if unsynced >= max_unsynced:
                         # backpressure barrier + periodic fetch (reached
                         # when no log window fetched recently, e.g.
@@ -851,8 +936,10 @@ class Trainer:
                         # run-ahead and keeps the guard abort live when
                         # logging is off — with the watchdog armed the
                         # buffers are already ready, so this is fetch-only
-                        metrics = jax.device_get(metrics)  # psl: sync-ok
-                        self._guard_check(metrics, step_no)
+                        with tr.span("sync", step=step_no):
+                            metrics = jax.device_get(metrics)  # psl: sync-ok
+                        with tr.span("guard", step=step_no):
+                            self._guard_check(metrics, step_no)
                         unsynced = 0
                     if (
                         t.save_checkpoints
@@ -862,13 +949,16 @@ class Trainer:
                         and t.eval_freq > 0
                         and step_no % t.eval_freq == 0
                     ):
-                        self._record_geometry(step_no)
-                        self._ckpt.save(
-                            self.state,
-                            t.train_dir,
-                            step_no,
-                            compress=t.compress_checkpoints,
-                        )
+                        # the span covers the host half (state gather +
+                        # submit); the write itself is async
+                        with tr.span("ckpt_save", step=step_no):
+                            self._record_geometry(step_no)
+                            self._ckpt.save(
+                                self.state,
+                                t.train_dir,
+                                step_no,
+                                compress=t.compress_checkpoints,
+                            )
                         last_saved = step_no
                     if step_no >= t.max_steps:
                         done = True
@@ -881,21 +971,21 @@ class Trainer:
                         done = True
                         break
             if t.save_checkpoints and metrics and last_saved != step_no:
-                self._record_geometry(step_no)
-                self._ckpt.save(
-                    self.state,
-                    t.train_dir,
-                    step_no,
-                    compress=t.compress_checkpoints,
-                )
+                with tr.span("ckpt_save", step=step_no):
+                    self._record_geometry(step_no)
+                    self._ckpt.save(
+                        self.state,
+                        t.train_dir,
+                        step_no,
+                        compress=t.compress_checkpoints,
+                    )
         finally:
-            if profiling:  # run ended (or raised) inside the window
-                jax.block_until_ready(self.state.params)
-                jax.profiler.stop_trace()
+            pw.close(self.state.params)  # run ended (or raised) mid-window
             # drain the async writer even on error, so a submitted
             # checkpoint is durable (or its failure raised) before the
             # caller observes the outcome
             self._ckpt.wait()
+            tr.flush()  # trailing partial window's spans
         out = {k: float(v) for k, v in metrics.items()}
         if out:
             # final drain of the guard's host half: a skip in a trailing

@@ -143,9 +143,12 @@ def test_two_process_hybrid_mesh_train_and_checkpoint(tmp_path):
     rows = []
     for i in (0, 1):
         with open(tmp_path / f"metrics_{i}.jsonl") as f:
-            rows.append(
-                [json.loads(l)["loss"] for l in f if '"train"' in l]
-            )
+            rows.append([
+                e["loss"] for e in map(json.loads, f)
+                # by kind, not substring: the run_header record also
+                # contains the text "train" ("component": "train")
+                if e.get("kind") == "train"
+            ])
     assert rows[0] == pytest.approx(rows[1]), "processes diverged"
 
     # the ordinary single-process evaluator consumes the multi-host file

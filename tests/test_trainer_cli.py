@@ -307,8 +307,12 @@ def test_metrics_file_written(tmp_path, tiny_ds):
     tr.validate()
     records = [json.loads(l) for l in open(path)]
     kinds = {r["kind"] for r in records}
-    assert kinds == {"train", "eval"}
-    assert all(np.isfinite(r["loss"]) for r in records)
+    # the stream opens with its run_header (obs/schema.py), then data
+    assert kinds == {"run_header", "train", "eval"}
+    assert records[0]["kind"] == "run_header"
+    assert all(
+        np.isfinite(r["loss"]) for r in records if r["kind"] != "run_header"
+    )
 
 
 def test_cli_train_lm_learns_markov_structure(tmp_path):

@@ -33,6 +33,10 @@ Usage:
   python tools/overlap_report.py hlo --workers 8 --network ResNet18
   python tools/overlap_report.py trace --profile-dir runs/profile/...
   python tools/overlap_report.py topology --topology v5e:2x4 --workers 8
+
+Folded into the observability front end as a subcommand — prefer
+``python tools/trace_report.py overlap <mode> [...]`` (same flags; this
+module remains the implementation).
 """
 
 from __future__ import annotations

@@ -8,8 +8,9 @@
 # default (int8 + EF + guard NaN-inject), the LM trainer on tp with
 # vocab-parallel embedding + the LM evaluator with KV-cache sampling,
 # the serving engine under open-loop traffic with one hot checkpoint
-# rollover, and the headline benchmark in its trimmed form. Budget
-# ~6 minutes of CPU (compiles dominate).
+# rollover, the observability leg (traced train + serve merged into one
+# Chrome timeline by tools/trace_report.py), and the headline benchmark
+# in its trimmed form. Budget ~7 minutes of CPU (compiles dominate).
 #
 #   bash tools/smoke.sh
 set -euo pipefail
@@ -122,15 +123,53 @@ run python -m ps_pytorch_tpu.cli.serve \
     --model-dir "$TMP/lm" --step 10 --slots 8 --max-len 64 \
     --requests 24 --rate 40 --prompt-min 4 --prompt-max 12 \
     --new-min 8 --new-max 16 --poll-interval 0.1 --num-workers 8 \
-    --summary-file "$TMP/serve.json"
+    --summary-file "$TMP/serve.json" --trace "$TMP/trace"
 run python - "$TMP/serve.json" <<'PYEOF'
 import json, math, sys
 s = json.load(open(sys.argv[1]))
 assert s["requests_completed"] == 24 and s["new_tokens"] > 0, s
 assert math.isfinite(s["p99_token_latency_s"]), s
 assert s["weights_step"] == 20 and len(s["rollovers"]) == 1, s
-print("serve smoke: %d tokens at %.1f tok/s, p99 %.4fs, rollover 10->20"
-      % (s["new_tokens"], s["tokens_per_sec"], s["p99_token_latency_s"]))
+assert math.isfinite(s["p99_queue_s"]) and math.isfinite(s["p99_prefill_s"]), s
+print("serve smoke: %d tokens at %.1f tok/s, p99 %.4fs (queue p99 %.4fs), "
+      "rollover 10->20"
+      % (s["new_tokens"], s["tokens_per_sec"], s["p99_token_latency_s"],
+         s["p99_queue_s"]))
+PYEOF
+
+# observability leg (ARCHITECTURE §7g): train 10 traced steps on the
+# 8-dev mesh (span stream + metrics run header; the injected NaN grad at
+# step 3 lands a grad_skip event for the overlay), merge with the
+# serving leg's trace (written above into the same dir — it includes the
+# rollover drain), and assert the merged Chrome timeline loads, spans
+# nest, and every required phase is present with sane percentiles
+run python -m ps_pytorch_tpu.cli.train \
+    --network LeNet --dataset MNIST --num-workers 8 --batch-size 64 \
+    --max-steps 10 --eval-freq 5 --log-interval 5 \
+    --fault-plan '{"nan_grads":[3]}' \
+    --trace "$TMP/trace" --metrics-file "$TMP/obs_train.jsonl" \
+    --train-dir "$TMP/obs"
+run python tools/trace_report.py "$TMP/trace" \
+    --metrics "$TMP/obs_train.jsonl" \
+    --out "$TMP/trace_merged.json" --summary-out "$TMP/trace_summary.json" \
+    --require-phases fetch,h2d,dispatch,sync,guard,ckpt_save,admit_prefill,decode_dispatch,token_fetch,evict,rollover_drain,rollover_swap,request \
+    > /dev/null
+run python - "$TMP/trace_merged.json" "$TMP/trace_summary.json" <<'PYEOF'
+import json, sys
+merged = json.load(open(sys.argv[1]))
+spans = [e for e in merged["traceEvents"] if e.get("ph") == "X"]
+assert spans and all(e["dur"] >= 0 and e["ts"] >= 0 for e in spans), "bad events"
+s = json.load(open(sys.argv[2]))
+assert s["nesting_ok"], s
+assert s["n_overlay_events"] >= 1, s  # the injected grad_skip marker
+assert {c["component"] for c in s["streams"]} == {"train", "serve"}, s["streams"]
+for name, st in s["phases"].items():
+    assert st["count"] >= 1 and 0 <= st["p50_s"] <= st["p99_s"], (name, st)
+frac = s["fraction_of_loop_walltime"]["train"]
+assert abs(sum(frac.values()) - 1.0) < 0.01, frac
+print("obs smoke: %d phases merged (train+serve), %d span events, "
+      "dispatch fraction %.2f"
+      % (len(s["phases"]), len(spans), frac.get("dispatch", 0.0)))
 PYEOF
 
 run python bench.py

@@ -1,0 +1,299 @@
+"""Merge per-process span-trace streams into one timeline and summarize.
+
+The observability layer (ps_pytorch_tpu/obs, ARCHITECTURE §7g) writes
+one JSONL stream per process per component: a ``run_header`` record
+(run id, schema version, wall+monotonic clock base) followed by
+``span`` records whose ``t``/``dur`` are seconds on the header's
+monotonic clock. This tool:
+
+- merges any number of streams (train + serve, multiple hosts) into ONE
+  perfetto-loadable Chrome trace (``--out``). Multihost merge rule: a
+  span's absolute time is ``header.t_wall + span.t`` — monotonic
+  offsets keep durations drift-free, the per-process wall base places
+  the streams on a shared timeline (hosts are NTP-aligned to well under
+  a log window, and each process keeps its own ``pid`` lane so skew
+  never interleaves within a track);
+- overlays metrics-JSONL events (``--metrics``: grad_skip, straggler
+  storms, mask_adapt, resume_reshape, checkpoint quarantine/failure) as
+  instant markers via their ``t_wall`` stamps;
+- prints a summary: per-phase count and p50/p99/total duration,
+  per-component fraction of loop walltime by top-level phase (where
+  does a step's time go: dispatch vs sync vs fetch), and a nesting
+  check (child spans must sit inside their parents — a violation means
+  a tracer bug, not a workload property);
+- ``--require-phases a,b,c`` exits nonzero unless every named phase is
+  present (the smoke gate).
+
+The earlier one-off analysis tools fold in as subcommands:
+
+  python tools/trace_report.py overlap <hlo|trace|topology> [...]
+      -> tools/overlap_report.py (comm/compute overlap evidence)
+  python tools/trace_report.py window [outdir]
+      -> tools/window_report.py (TPU bench-window rollup)
+
+Usage:
+  python tools/trace_report.py runs/trace/ --metrics runs/metrics.jsonl \\
+      --out runs/trace_merged.json --summary-out runs/trace_summary.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+from typing import Dict, List, Optional, Tuple
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+from ps_pytorch_tpu.obs import (  # noqa: E402
+    chrome_trace_events,
+    summarize_spans,
+)
+
+# metrics-JSONL kinds rendered as instant overlay markers on the merged
+# timeline (anything else in the metrics stream is ignored here)
+OVERLAY_KINDS = (
+    "grad_skip", "straggler", "straggler_storm", "straggler_storm_end",
+    "mask_adapt", "resume_reshape", "ckpt_quarantined", "ckpt_write_failed",
+)
+
+# tiny tolerance for the nesting check: span times round to 1 µs in the
+# files, so exact-boundary children can overhang by a rounding quantum
+_NEST_EPS_S = 5e-6
+
+
+def load_stream(path: str) -> List[Tuple[dict, List[dict]]]:
+    """One trace file -> list of (run_header, spans) SEGMENTS.
+
+    Tracer.flush appends, so re-running with the same --trace dir (a
+    --resume continuation) writes a fresh run_header mid-file — and each
+    segment's span offsets are on ITS OWN header's monotonic clock, so
+    they must be rebased per segment, never against the first header."""
+    segments: List[Tuple[dict, List[dict]]] = []
+    header: Optional[dict] = None
+    spans: List[dict] = []
+    with open(path) as f:
+        for line in f:
+            line = line.strip()
+            if not line:
+                continue
+            rec = json.loads(line)
+            kind = rec.get("kind")
+            if kind == "run_header":
+                if header is not None:
+                    segments.append((header, spans))
+                header, spans = rec, []
+            elif kind == "span":
+                if header is None:
+                    raise SystemExit(
+                        f"{path}: span record before any run_header — "
+                        f"not an obs trace stream"
+                    )
+                spans.append(rec)
+    if header is not None:
+        segments.append((header, spans))
+    return segments
+
+
+def discover(inputs: List[str]) -> List[str]:
+    """Expand dirs to their trace_*.jsonl files; pass files through."""
+    out: List[str] = []
+    for item in inputs:
+        if os.path.isdir(item):
+            out.extend(sorted(glob.glob(os.path.join(item, "trace_*.jsonl"))))
+        else:
+            out.append(item)
+    return out
+
+
+def check_nesting(spans: List[dict]) -> int:
+    """Count nesting violations within one stream: spans sorted by start
+    must close inside whatever span is open above them (classic interval
+    stack). Async interval spans (request lifecycles, rollover drains)
+    overlap the stack by design and are excluded. Returns the violation
+    count."""
+    # at equal starts the LONGER span is the parent and must enter the
+    # stack first, hence the -end tiebreak
+    ordered = sorted(
+        (
+            (float(s["t"]), float(s["t"]) + float(s["dur"]))
+            for s in spans if not s.get("async")
+        ),
+        key=lambda se: (se[0], -se[1]),
+    )
+    stack: List[float] = []
+    bad = 0
+    for start, end in ordered:
+        while stack and stack[-1] <= start + _NEST_EPS_S:
+            stack.pop()
+        if stack and end > stack[-1] + _NEST_EPS_S:
+            bad += 1
+        stack.append(end)
+    return bad
+
+
+def merge(
+    trace_files: List[str], metrics_files: List[str]
+) -> Tuple[dict, dict]:
+    """-> (chrome_trace dict, summary dict)."""
+    streams = []
+    for path in trace_files:
+        segments = load_stream(path)
+        if not segments:
+            # a span file without identity cannot be placed on the wall
+            # timeline; surface it instead of silently mis-merging
+            raise SystemExit(
+                f"{path}: no run_header record — not an obs trace stream"
+            )
+        for header, spans in segments:
+            streams.append((path, header, spans))
+    overlays = []
+    for path in metrics_files or []:
+        with open(path) as f:
+            for line in f:
+                line = line.strip()
+                if not line:
+                    continue
+                rec = json.loads(line)
+                if rec.get("kind") in OVERLAY_KINDS and "t_wall" in rec:
+                    overlays.append(rec)
+    if not streams and not overlays:
+        raise SystemExit("no trace streams and no overlay events found")
+
+    walls = [h["t_wall"] for _, h, _ in streams]
+    walls += [o["t_wall"] for o in overlays]
+    t0_wall = min(walls)
+
+    events: List[dict] = []
+    used_pids = set()
+    for i, (path, header, spans) in enumerate(streams):
+        # distinct pid lane per stream even if two headers claim pid 0
+        # (train + serve on one host)
+        pid = int(header.get("pid", 0))
+        while pid in used_pids:
+            pid += 100
+        used_pids.add(pid)
+        events.extend(
+            chrome_trace_events(header, spans, pid=pid, t0_wall=t0_wall)
+        )
+    for o in overlays:
+        events.append({
+            "name": o["kind"],
+            "cat": "event",
+            "ph": "i",
+            "s": "g",  # global scope: draws a full-height marker line
+            "ts": round((o["t_wall"] - t0_wall) * 1e6, 3),
+            "pid": 0,
+            "tid": 0,
+            "args": {k: v for k, v in o.items() if k != "t_wall"},
+        })
+
+    all_spans = [s for _, _, spans in streams for s in spans]
+    phases = summarize_spans(all_spans)
+    # fraction of loop walltime by TOP-LEVEL phase, per component (a
+    # nested span — h2d under fetch — must not double-count, and async
+    # intervals overlap the loop phases so they must not either).
+    # AGGREGATED over every stream of the component: a multihost merge
+    # has one stream per process and a straggler host's dispatch/sync
+    # split must weigh in, not be overwritten by the last-listed file.
+    totals: Dict[str, Dict[str, float]] = {}
+    for _, header, spans in streams:
+        by = totals.setdefault(header.get("component", "?"), {})
+        for s in spans:
+            if s.get("depth", 0) == 0 and not s.get("async"):
+                by[s["name"]] = by.get(s["name"], 0.0) + float(s["dur"])
+    fractions: Dict[str, Dict[str, float]] = {}
+    for comp, by in totals.items():
+        total = sum(by.values())
+        if total > 0:
+            fractions[comp] = {
+                k: round(v / total, 4) for k, v in sorted(by.items())
+            }
+    nest_bad = sum(check_nesting(spans) for _, _, spans in streams)
+    summary = {
+        "streams": [
+            {
+                "path": path,
+                "component": h.get("component"),
+                "run_id": h.get("run_id"),
+                "pid": h.get("pid", 0),
+                "schema_version": h.get("schema_version"),
+                "n_spans": len(spans),
+            }
+            for path, h, spans in streams
+        ],
+        "n_overlay_events": len(overlays),
+        "phases": phases,
+        "fraction_of_loop_walltime": fractions,
+        "nesting_violations": nest_bad,
+        "nesting_ok": nest_bad == 0,
+    }
+    trace = {"traceEvents": events, "displayTimeUnit": "ms"}
+    return trace, summary
+
+
+def main(argv=None) -> int:
+    argv = list(sys.argv[1:] if argv is None else argv)
+    # folded one-off tools ride as subcommands (their modules remain the
+    # implementation and keep their own CLIs working)
+    if argv and argv[0] == "overlap":
+        import overlap_report
+
+        overlap_report.main(argv[1:])
+        return 0
+    if argv and argv[0] == "window":
+        import window_report
+
+        return window_report.main(argv[1] if len(argv) > 1 else "runs/tpu_r04")
+
+    p = argparse.ArgumentParser(
+        "tools/trace_report.py",
+        description="merge obs span-trace streams; see module docstring",
+    )
+    p.add_argument("inputs", nargs="+",
+                   help="trace dirs (trace_*.jsonl inside) and/or files")
+    p.add_argument("--metrics", action="append", default=[],
+                   help="metrics JSONL to overlay as instant markers "
+                        "(repeatable)")
+    p.add_argument("--out", default=None,
+                   help="write the merged Chrome trace JSON here "
+                        "(load in perfetto/chrome://tracing)")
+    p.add_argument("--summary-out", default=None,
+                   help="write the summary JSON here")
+    p.add_argument("--require-phases", default=None,
+                   help="comma-separated phase names that must appear; "
+                        "missing ones exit 1 (smoke gate)")
+    args = p.parse_args(argv)
+
+    files = discover(args.inputs)
+    if not files and not args.metrics:
+        print(f"no trace_*.jsonl under {args.inputs}", file=sys.stderr)
+        return 1
+    trace, summary = merge(files, args.metrics)
+    print(json.dumps(summary, indent=2))
+    if args.out:
+        d = os.path.dirname(args.out)
+        if d:
+            os.makedirs(d, exist_ok=True)
+        with open(args.out, "w") as f:
+            json.dump(trace, f)
+        print(f"# merged trace: {args.out} "
+              f"({len(trace['traceEvents'])} events)", file=sys.stderr)
+    if args.summary_out:
+        with open(args.summary_out, "w") as f:
+            json.dump(summary, f, indent=2)
+    if args.require_phases:
+        need = {s for s in args.require_phases.split(",") if s}
+        missing = sorted(need - set(summary["phases"]))
+        if missing:
+            print(f"missing required phases: {missing}", file=sys.stderr)
+            return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -6,6 +6,10 @@ MFU, chain, date), plus one-line summaries of the validator sweep and the
 comm-overlap artifacts. Pure reader — it never mutates the evidence.
 
   python tools/window_report.py runs/tpu_r04
+
+Folded into the observability front end as a subcommand — prefer
+``python tools/trace_report.py window [outdir]`` (this module remains
+the implementation).
 """
 
 from __future__ import annotations
