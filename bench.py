@@ -404,6 +404,20 @@ def _layout_tag() -> str:
     return ""
 
 
+# BENCH_AB_OVERLAP=1 runs the CNN workload TWICE in one process —
+# PSConfig.overlap="serial" then "pipelined" on the same wire
+# (BENCH_BUCKET_BYTES or the fused plan) — and emits both in ONE record:
+# per-variant step walltime, dispatch/sync span breakdown (an in-memory
+# obs tracer around the measured window), compiled hlo_op_count, and the
+# jaxpr schedule-freedom numbers (parallel/overlap.py), so the record
+# carries both what the host measured and what the program's dataflow
+# permits. Mutually exclusive with the other A/B dimensions.
+def _overlap_tag() -> str:
+    if os.environ.get("BENCH_AB_OVERLAP") == "1":
+        return "_ab_overlap"
+    return ""
+
+
 def _comm_contract_entry(workload: str, compress, bucket_bytes):
     """The committed pscheck accounting row for the PS config this CNN
     workload trains: {config, n_collectives, wire_bytes, mesh_devices}
@@ -666,10 +680,10 @@ def _validate_env() -> None:
     # AB=0 is the documented "off" value — as inert as unset, so a CI
     # wrapper exporting it globally must not abort the lm/decode legs
     for knob in ("BENCH_BUCKET_BYTES", "BENCH_AB_BUCKETING",
-                 "BENCH_AB_STATE_LAYOUT"):
+                 "BENCH_AB_STATE_LAYOUT", "BENCH_AB_OVERLAP"):
         val = os.environ.get(knob)
-        if knob in ("BENCH_AB_BUCKETING", "BENCH_AB_STATE_LAYOUT") \
-                and val == "0":
+        if knob in ("BENCH_AB_BUCKETING", "BENCH_AB_STATE_LAYOUT",
+                    "BENCH_AB_OVERLAP") and val == "0":
             val = None
         if val is not None and os.environ.get(
             "BENCH_WORKLOAD", "lenet"
@@ -678,11 +692,15 @@ def _validate_env() -> None:
                 f"{knob} only applies to the CNN (PS) workloads; "
                 "it would be silently ignored for lm/decode/serve"
             )
-    if (os.environ.get("BENCH_AB_BUCKETING") == "1"
-            and os.environ.get("BENCH_AB_STATE_LAYOUT") == "1"):
+    ab_on = [
+        k for k in ("BENCH_AB_BUCKETING", "BENCH_AB_STATE_LAYOUT",
+                    "BENCH_AB_OVERLAP")
+        if os.environ.get(k) == "1"
+    ]
+    if len(ab_on) > 1:
         raise SystemExit(
-            "BENCH_AB_BUCKETING and BENCH_AB_STATE_LAYOUT are mutually "
-            "exclusive — one A/B dimension per record"
+            f"{' and '.join(ab_on)} are mutually exclusive — one A/B "
+            "dimension per record"
         )
     if os.environ.get("BENCH_BUCKET_BYTES") is not None:
         try:
@@ -697,7 +715,16 @@ def _validate_env() -> None:
                 "BENCH_BUCKET_BYTES must be >= 0 (unset it for the "
                 "legacy per-leaf wire)"
             )
-    for knob in ("BENCH_AB_BUCKETING", "BENCH_AB_STATE_LAYOUT"):
+        if bb == 0 and os.environ.get("BENCH_AB_OVERLAP") == "1":
+            raise SystemExit(
+                "BENCH_AB_OVERLAP with BENCH_BUCKET_BYTES=0 is a "
+                "degenerate A/B: one fused bucket still depends on every "
+                "gradient leaf, so the pipelined variant traces the "
+                "serial schedule — pick a multi-bucket size (e.g. 65536) "
+                "or unset it for the 64 KiB default"
+            )
+    for knob in ("BENCH_AB_BUCKETING", "BENCH_AB_STATE_LAYOUT",
+                 "BENCH_AB_OVERLAP"):
         if os.environ.get(knob) not in (None, "0", "1"):
             raise SystemExit(
                 f"{knob} must be 0 or 1, got {os.environ[knob]!r}"
@@ -774,7 +801,8 @@ def _success_metric() -> str:
         return f"serve_{_srv_tag()}_tokens_per_sec"
     metric = WORKLOADS.get(name, {}).get("metric") or f"{name}_train_throughput"
     _, ctag = _cnn_compress(WORKLOADS.get(name, {}).get("compress"))
-    return metric + ctag + _bucket_tag() + _layout_tag() + _cnn_dtype_suffix()
+    return (metric + ctag + _bucket_tag() + _layout_tag()
+            + _overlap_tag() + _cnn_dtype_suffix())
 
 
 def _attach_banked(rec: dict) -> None:
@@ -970,15 +998,20 @@ def main() -> None:
     req_steps = int(os.environ.get("BENCH_STEPS", REF_STEPS))
 
     def run_variant(bucket_bytes, state_layout="flat",
-                    probe_update_path=False):
-        """Measure one (wire granularity, state layout) end to end;
-        returns the variant's sub-record plus (loss, elapsed, steps,
-        flops, chain)."""
+                    probe_update_path=False, overlap="serial",
+                    probe_overlap=False, spans=False):
+        """Measure one (wire granularity, state layout, schedule) end to
+        end; returns the variant's sub-record plus (loss, elapsed,
+        steps, flops, chain). ``spans`` wraps the measured window in an
+        in-memory obs tracer (per-step dispatch + sync spans) and
+        ``probe_overlap`` adds the jaxpr schedule-freedom numbers —
+        both used by the BENCH_AB_OVERLAP leg."""
         from ps_pytorch_tpu.optim import build_optimizer
 
         cfg = PSConfig(
             num_workers=n_dev, compress=compress,
             bucket_bytes=bucket_bytes, state_layout=state_layout,
+            overlap=overlap,
         )
         # the flat layout takes the whole-vector optimizer variant (the
         # trainer's own pairing); the math is bit-identical either way
@@ -1009,9 +1042,37 @@ def main() -> None:
             # jaxpr ops downstream of the gradient reduce — the count
             # the flat state layout collapses (trace-only, no compile)
             update_ops = update_path_op_count(step, state, sharded, key)
+        overlap_probe = None
+        if probe_overlap:
+            from ps_pytorch_tpu.parallel.overlap import (
+                jaxpr_overlap_headroom,
+            )
+
+            rep = jaxpr_overlap_headroom(step, state, sharded, key)
+            rep.pop("per_collective", None)
+            overlap_probe = rep
         steps = req_steps
         k = min(_chain(), steps)  # same budget clamp as the lm path
-        if k > 1:
+        span_summary = None
+        if spans:
+            # per-step dispatch/sync spans via the in-memory tracer: the
+            # dispatch span is the (async) enqueue, the sync span the
+            # host's wait for the step to retire — per-step host_sync so
+            # every step contributes one pair (the chained fast path
+            # would hide the split)
+            from ps_pytorch_tpu.obs import Tracer, summarize_spans
+
+            tr = Tracer("bench", path=None)
+            t0 = time.perf_counter()
+            for _ in range(steps):
+                with tr.span("dispatch"):
+                    state, metrics = step(state, sharded, key)
+                with tr.span("sync"):
+                    host_sync(state.params, metrics)
+            elapsed = time.perf_counter() - t0
+            span_summary = summarize_spans(tr.drain())
+            k = 1
+        elif k > 1:
             carry, elapsed, steps = _timed_chain(
                 lambda c: step(c[0], sharded, key), (state, metrics),
                 lambda c: host_sync(c[0].params, c[1]), steps, k,
@@ -1043,8 +1104,23 @@ def main() -> None:
             # perf trajectory records the wire, not just walltime
             "comm": _comm_contract_entry(name, compress, bucket_bytes),
         }
+        sub["overlap"] = overlap
         if update_ops is not None:
             sub["update_path_ops"] = update_ops
+        if overlap_probe is not None:
+            sub["overlap_jaxpr"] = overlap_probe
+        if span_summary is not None:
+            d = span_summary.get("dispatch", {})
+            y = span_summary.get("sync", {})
+            sub["spans"] = span_summary
+            tot = d.get("total_s", 0.0) + y.get("total_s", 0.0)
+            # fraction of the host's step wall spent with the work
+            # already dispatched (the async window a latency-hiding
+            # schedule can fill) vs blocked in the sync — the
+            # span-derived overlap fraction the A/B record banks
+            sub["overlap_fraction_spans"] = (
+                round(d.get("total_s", 0.0) / tot, 4) if tot else None
+            )
         return sub, loss, elapsed, steps, flops, k
 
     if os.environ.get("BENCH_AB_BUCKETING") == "1":
@@ -1121,6 +1197,48 @@ def main() -> None:
                     if sub_tree.get("update_path_ops")
                     and sub_flat.get("update_path_ops")
                     else None
+                ),
+            },
+        }
+    elif os.environ.get("BENCH_AB_OVERLAP") == "1":
+        # A/B leg: serial vs pipelined SCHEDULE in one process on the
+        # same wire — per-variant step walltime, per-step dispatch/sync
+        # span breakdown, hlo_op_count, and the jaxpr schedule-freedom
+        # probe all land in one record. Headline = pipelined.
+        bb = _bench_bucket_bytes()
+        if bb is None:
+            # a MULTI-bucket default: bb=0 (one fused bucket) would make
+            # the A/B degenerate — a single bucket still depends on every
+            # leaf, so "pipelined" would trace the serial schedule and
+            # the record would read "pipelining gains nothing" about an
+            # experiment that never pipelined
+            bb = 64 << 10
+        sub_ser, *_ = run_variant(
+            bb, overlap="serial", probe_overlap=True, spans=True
+        )
+        sub_pip, loss, elapsed, steps, flops, k = run_variant(
+            bb, overlap="pipelined", probe_overlap=True, spans=True
+        )
+        images_per_sec = sub_pip["images_per_sec"]
+        rec = {
+            "run": _run_info(n_dev, device_kind),
+            "phases": sub_pip["phases"],
+            "metric": _success_metric() + suffix,
+            "value": images_per_sec,
+            "unit": "images/sec",
+            "vs_baseline": round(images_per_sec / REF_IMAGES_PER_SEC, 2),
+            "mfu": _mfu(flops, steps, elapsed, jax, n_devices=n_dev),
+            "device": device_kind,
+            "timestamp": _utc_now(),
+            "hlo_op_count": sub_pip["hlo_op_count"],
+            "comm": sub_pip["comm"],
+            "ab_overlap": {
+                "serial": sub_ser,
+                "pipelined": sub_pip,
+                "speedup": round(
+                    sub_pip["images_per_sec"]
+                    / max(sub_ser["images_per_sec"], 1e-9),
+                    3,
                 ),
             },
         }

@@ -11,7 +11,9 @@ donation survives lowering (PSC105), bucketed wires stay fused — no
 more gradient-path collectives than the declared bucket plan allows
 (PSC106) — the serving hot path stays collective-free with an
 honest KV storage dtype (PSC107), and adaptive-mask configs keep their
-grad-reduce declaration and byte envelope (PSC108).
+grad-reduce declaration and byte envelope (PSC108), and pipelined
+configs move exactly their serial twin's bytes with a real per-bucket
+dispatch (PSC109).
 
 Entry points: ``python -m ps_pytorch_tpu.check``, ``tools/check.sh``,
 and the tier-1 gate in tests/test_check.py.
@@ -24,6 +26,7 @@ from .contracts import (
     DonationSpec,
     FusionSpec,
     GradReduce,
+    OverlapPolicy,
     ServePolicy,
     WireAllowance,
     WirePolicy,
@@ -52,6 +55,7 @@ __all__ = [
     "DonationSpec",
     "FusionSpec",
     "GradReduce",
+    "OverlapPolicy",
     "RULE_IDS",
     "ServePolicy",
     "TraceResult",

@@ -135,6 +135,26 @@ class AdaptivePolicy:
 
 
 @dataclasses.dataclass(frozen=True)
+class OverlapPolicy:
+    """PSC109: schedule invariance for the pipelined bucket wire.
+
+    A config running ``PSConfig.overlap="pipelined"`` declares (a) its
+    mode and (b) the NAME of its serial twin — the identical config with
+    ``overlap="serial"``. The rule then pins "same bytes, different
+    schedule": the pipelined trace's gradient-path reduce bytes must
+    equal the twin's exactly (pipelining reorders and splits the
+    schedule, it never moves different bytes), the per-bucket dispatch
+    must be real — at least ``n_buckets`` (× the scheme's per-bucket
+    collective cost) reduce-kind collectives each feeding the updated
+    params, so PSC102's dataflow guarantee holds PER BUCKET rather than
+    only in aggregate — and a config claiming ``pipelined`` whose wire
+    de-pipelined back to one fused eqn fails loudly."""
+
+    mode: str = "pipelined"
+    serial_twin: Optional[str] = None
+
+
+@dataclasses.dataclass(frozen=True)
 class ServePolicy:
     """PSC107: the serving hot path's contract (serve/engine.py).
 
@@ -173,6 +193,7 @@ class ContractSpec:
     fusion: Optional[FusionSpec] = None
     serve: Optional[ServePolicy] = None
     adaptive: Optional[AdaptivePolicy] = None
+    overlap: Optional[OverlapPolicy] = None
 
 
 # metrics / loss pmean: a handful of f32 scalars, every scheme emits it
@@ -280,6 +301,8 @@ def _ps_spec(
     network: str = "LeNet",
     state_layout: str = "flat",
     adaptive: bool = False,
+    overlap: str = "serial",
+    bucket_tag: str = "",
 ) -> ContractSpec:
     from ..parallel.mesh import DCN_AXIS, WORKER_AXIS
 
@@ -289,9 +312,15 @@ def _ps_spec(
     if network != "LeNet":
         name = name.replace("ps_", f"ps_{network.lower()}_", 1)
     if bucket_bytes is not None:
-        name += "_bucketed"
+        # bucket_tag distinguishes registry entries traced with a
+        # different carving of the same scheme (e.g. the 64 KiB
+        # multi-bucket PSC109 twins vs the fused "_bucketed" entries)
+        name += "_bucketed" + bucket_tag
     if adaptive:
         name += "_adaptive"
+    if overlap == "pipelined":
+        serial_twin = name
+        name += "_pipelined"
     if state_layout != "flat":
         # layout-parity twins only (layout_parity_pairs) — the registry
         # itself carries the default layout, and state layout is
@@ -311,6 +340,7 @@ def _ps_spec(
             dcn_hosts=dcn_hosts,
             bucket_bytes=bucket_bytes,
             state_layout=state_layout,
+            overlap=overlap,
             num_aggregate_min=2 if adaptive else None,
             num_aggregate_max=MESH_DEVICES if adaptive else None,
         )
@@ -391,6 +421,11 @@ def _ps_spec(
             envelope_bytes=plan.padded_total * 4,
         )
 
+    overlap_policy = None
+    if overlap == "pipelined":
+        overlap_policy = OverlapPolicy(mode="pipelined",
+                                       serial_twin=serial_twin)
+
     return ContractSpec(
         name=name,
         build=build,
@@ -400,6 +435,7 @@ def _ps_spec(
         donation=DonationSpec(argnums=(0,), out_positions=(0,)),
         fusion=fusion,
         adaptive=adaptive_policy,
+        overlap=overlap_policy,
     )
 
 
@@ -696,6 +732,24 @@ def get_contracts() -> Tuple[ContractSpec, ...]:
         _ps_spec(None, "replicated", bucket_bytes=0, adaptive=True)
     )
     specs.append(_ps_spec("int8", "sharded", adaptive=True))
+    # PSC109 serial/pipelined twins (overlap="pipelined", §6g): a
+    # genuinely multi-bucket LeNet pair per wire family at 64 KiB
+    # buckets (LeNet's ~1.7 MB payload -> ~27 buckets), the flagship
+    # ResNet18 int8 4 MiB config's pipelined twin, and the ZeRO-1
+    # scatter's — each pipelined entry pins "same bytes, different
+    # schedule" against the serial entry traced beside it
+    for ov in ("serial", "pipelined"):
+        specs.append(_ps_spec(None, "replicated", bucket_bytes=64 << 10,
+                              bucket_tag="64k", overlap=ov))
+        specs.append(_ps_spec("int8", "replicated", bucket_bytes=64 << 10,
+                              bucket_tag="64k", overlap=ov))
+    specs.append(
+        _ps_spec(
+            "int8", "replicated", network="ResNet18",
+            bucket_bytes=RESNET_BUCKET_BYTES, overlap="pipelined",
+        )
+    )
+    specs.append(_ps_spec("int8", "sharded", overlap="pipelined"))
     specs.extend(
         [_dp_tp_spec(), _pp_spec(), _moe_spec(), _dp_tp_pp_spec()]
     )

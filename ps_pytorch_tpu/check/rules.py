@@ -36,6 +36,15 @@
 |        | dataflow rule keeps pinning the masked reduce — and its         |
 |        | gradient-path reduce bytes must stay inside the declared        |
 |        | envelope: adaptation reshapes values, never wire bytes          |
+| PSC109 | schedule-variance on the pipelined wire: a config declaring an  |
+|        | OverlapPolicy (PSConfig.overlap="pipelined") must move EXACTLY  |
+|        | the gradient-path reduce bytes of its named serial twin (same   |
+|        | bytes, different schedule — pipelining may reorder and split,   |
+|        | never grow or shrink the wire), and must really dispatch per    |
+|        | bucket: at least n_buckets x per_bucket reduce-kind             |
+|        | collectives each feeding the updated params, so the PSC102      |
+|        | dataflow guarantee holds PER BUCKET — a "pipelined" config      |
+|        | whose wire quietly re-fused into one barrier eqn fails          |
 """
 
 from __future__ import annotations
@@ -46,7 +55,7 @@ from .core import CheckFinding, TraceResult
 from .walker import REDUCE_KINDS
 
 RULE_IDS = ("PSC101", "PSC102", "PSC103", "PSC104", "PSC105", "PSC106",
-            "PSC107", "PSC108")
+            "PSC107", "PSC108", "PSC109")
 
 
 def psc101_axes(r: TraceResult) -> List[CheckFinding]:
@@ -133,11 +142,7 @@ def psc106_fusion(r: TraceResult) -> List[CheckFinding]:
     fu = r.spec.fusion
     if fu is None:
         return []
-    got = sum(
-        1
-        for c in r.collectives
-        if c.feeds_params and c.kind in REDUCE_KINDS
-    )
+    got = _grad_reduce_count(r)
     if got <= fu.max_collectives:
         return []
     granularity = (
@@ -221,11 +226,7 @@ def psc108_adaptive(r: TraceResult) -> List[CheckFinding]:
             "requirement — without it PSC102 cannot pin the masked "
             "reduce's dataflow to the updated params",
         ))
-    got = sum(
-        c.bytes
-        for c in r.collectives
-        if c.feeds_params and c.kind in REDUCE_KINDS
-    )
+    got = _grad_reduce_bytes(r)
     if got > ap.envelope_bytes:
         out.append(CheckFinding(
             "PSC108", r.spec.name,
@@ -234,6 +235,73 @@ def psc108_adaptive(r: TraceResult) -> List[CheckFinding]:
             f"{ap.max_aggregate}) declares at most {ap.envelope_bytes} B "
             f"— the traced mask must reshape values, not add wire bytes",
         ))
+    return out
+
+
+def _grad_reduce_bytes(r: TraceResult) -> int:
+    return sum(
+        c.bytes
+        for c in r.collectives
+        if c.feeds_params and c.kind in REDUCE_KINDS
+    )
+
+
+def _grad_reduce_count(r: TraceResult) -> int:
+    return sum(
+        1
+        for c in r.collectives
+        if c.feeds_params and c.kind in REDUCE_KINDS
+    )
+
+
+def psc109_schedule(results: Sequence[TraceResult]) -> List[CheckFinding]:
+    """Schedule invariance for pipelined configs (cross-result rule,
+    like PSC104): byte-equality against the serial twin when the twin
+    was traced in the same batch, and per-bucket dispatch — the
+    pipelined wire must emit one reduce chain per bucket (x the
+    scheme's per-bucket collective cost), each a dataflow ancestor of
+    the updated params."""
+    out: List[CheckFinding] = []
+    by_name = {r.spec.name: r for r in results}
+    for r in results:
+        ov = r.spec.overlap
+        if ov is None or ov.mode != "pipelined":
+            continue
+        fu = r.spec.fusion
+        if fu is None:
+            out.append(CheckFinding(
+                "PSC109", r.spec.name,
+                "pipelined overlap declared without a FusionSpec — the "
+                "per-bucket dispatch requirement needs the bucket plan "
+                "to know how many reduce chains to demand",
+            ))
+        else:
+            want = fu.per_bucket * fu.n_buckets
+            got = _grad_reduce_count(r)
+            if got < want:
+                out.append(CheckFinding(
+                    "PSC109", r.spec.name,
+                    f"only {got} gradient-path reduce collectives for a "
+                    f"pipelined plan of {fu.n_buckets} bucket(s) "
+                    f"(x{fu.per_bucket} per bucket = {want} expected) — "
+                    f"the wire has re-fused into a barrier; the "
+                    f"schedule is serial no matter what the config "
+                    f"declares",
+                ))
+        twin = by_name.get(ov.serial_twin) if ov.serial_twin else None
+        if twin is None:
+            # the twin wasn't traced in this batch (e.g. --only) — the
+            # byte pin still holds transitively via PSC104 on both
+            continue
+        mine, theirs = _grad_reduce_bytes(r), _grad_reduce_bytes(twin)
+        if mine != theirs:
+            out.append(CheckFinding(
+                "PSC109", r.spec.name,
+                f"gradient-path reduce collectives move {mine} B but the "
+                f"serial twin '{twin.spec.name}' moves {theirs} B — "
+                f"pipelining must reorder the schedule, never change "
+                f"the bytes",
+            ))
     return out
 
 

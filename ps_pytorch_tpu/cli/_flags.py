@@ -166,6 +166,18 @@ def add_ps_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "aligned to the quantization block "
                              "(O(n_buckets) collectives instead of "
                              "O(n_leaves); parallel/buckets.py)")
+    parser.add_argument("--overlap", type=str, default="off",
+                        choices=("on", "off"),
+                        help="pipelined bucket reduction: launch each "
+                             "bucket's collective as soon as its leaves' "
+                             "gradients are ready (readiness-ordered "
+                             "dispatch + per-bucket optimizer updates, "
+                             "parallel/buckets.py §6g). Same bytes as the "
+                             "serial schedule (PSC109 pins it); off = the "
+                             "committed-contract baseline. Default off: "
+                             "the CPU A/B shows parity (XLA:CPU runs "
+                             "collectives synchronously) — the "
+                             "latency-hiding win needs a TPU run to bank")
     parser.add_argument("--state-layout", type=str, default="flat",
                         choices=("tree", "flat"),
                         help="where master params/optimizer moments live: "
@@ -274,6 +286,7 @@ def ps_config_from(args: argparse.Namespace, num_workers: int) -> PSConfig:
             None if args.bucket_bytes < 0 else args.bucket_bytes
         ),
         state_layout=args.state_layout,
+        overlap="pipelined" if args.overlap == "on" else "serial",
         error_feedback=args.error_feedback,
         opt_placement=args.opt_placement,
         bn_mode=args.bn_mode,

@@ -71,7 +71,15 @@ from .ulysses import (
     make_ulysses_attention,
     ulysses_attention,
 )
-from .buckets import FlatVector, tree_view
+from .buckets import (
+    FlatVector,
+    assemble_bucket,
+    bucket_leaf_segments,
+    leaves_from_buckets,
+    readiness_bucket_order,
+    tree_view,
+)
+from .overlap import grad_leaf_readiness, jaxpr_overlap_headroom
 from .ps import (
     PSConfig,
     PSTrainState,

@@ -46,7 +46,7 @@ edge, so checkpoints are bit-portable across ``state_layout`` (and
 from __future__ import annotations
 
 import dataclasses
-from typing import Any, List, Sequence, Tuple
+from typing import Any, List, Optional, Sequence, Tuple
 
 import flax.struct
 import jax
@@ -177,6 +177,136 @@ def split_buckets(flat_padded: jax.Array, plan: BucketPlan) -> List[jax.Array]:
     ]
 
 
+def bucket_leaf_segments(layout: TreeLayout, plan: BucketPlan):
+    """Which leaf fragments make up each bucket — the static inverse of
+    "concatenate everything, then slice".
+
+    Returns one tuple per bucket of ``(leaf_index, leaf_offset, length)``
+    fragments in flat-buffer order; ``leaf_index is None`` marks the
+    alignment-padding tail (zeros). This is what lets the pipelined wire
+    assemble bucket ``b`` from ONLY the leaves whose bytes live in it:
+    the serial spelling's global ``tree_to_flat`` concat makes every
+    bucket's collective a dataflow descendant of every gradient leaf, so
+    no scheduler — XLA's latency-hiding one included — may start any
+    reduction before the whole backward finishes."""
+    leaf_spans = []
+    for i, (shape, off) in enumerate(zip(layout.shapes, layout.offsets)):
+        n = 1
+        for d in shape:
+            n *= d
+        if n:
+            leaf_spans.append((off, off + n, i))
+    out = []
+    li = 0
+    for start, size in zip(plan.starts, plan.sizes):
+        end = start + size
+        frags = []
+        cur = start
+        while li < len(leaf_spans) and leaf_spans[li][1] <= cur:
+            li += 1
+        j = li
+        while j < len(leaf_spans) and leaf_spans[j][0] < end:
+            l0, l1, idx = leaf_spans[j]
+            s, e = max(cur, l0), min(end, l1)
+            if s < e:
+                frags.append((idx, s - l0, e - s))
+                cur = e
+            j += 1
+        if cur < end:  # padding tail past the last leaf
+            frags.append((None, 0, end - cur))
+        out.append(tuple(frags))
+    return tuple(out)
+
+
+def assemble_bucket(leaves: Sequence[jax.Array], segments) -> jax.Array:
+    """Build one contiguous f32 bucket from its own leaf fragments
+    (``bucket_leaf_segments`` rows). Value-identical to slicing the
+    padded global concat, but the result depends ONLY on the leaves in
+    this bucket — the dataflow property the pipelined schedule needs."""
+    parts = []
+    for idx, off, n in segments:
+        if idx is None:
+            parts.append(jnp.zeros((n,), jnp.float32))
+            continue
+        leaf = leaves[idx].astype(jnp.float32).reshape(-1)
+        if off == 0 and n == leaf.shape[0]:
+            parts.append(leaf)
+        else:
+            parts.append(jax.lax.slice(leaf, (off,), (off + n,)))
+    if not parts:
+        return jnp.zeros((0,), jnp.float32)
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
+def leaves_from_buckets(layout: TreeLayout, plan: BucketPlan, outs):
+    """Rebuild the tree from per-bucket results (CANONICAL bucket order)
+    without concatenating the full vector first: each leaf gathers only
+    the fragments of the buckets its bytes live in, so a leaf's rebuilt
+    value is a dataflow descendant of ITS buckets alone (the per-leaf
+    mirror of ``assemble_bucket``; the serial ``flat_to_tree(concat(...))``
+    would chain every leaf behind every bucket's reduction)."""
+    leaves = []
+    for shape, dtype, off in zip(layout.shapes, layout.dtypes,
+                                 layout.offsets):
+        n = 1
+        for d in shape:
+            n *= d
+        parts = []
+        pos = off
+        for b, (bs, sz) in enumerate(zip(plan.starts, plan.sizes)):
+            be = bs + sz
+            if be <= pos or bs >= off + n:
+                continue
+            s, e = max(pos, bs), min(off + n, be)
+            if s < e:
+                piece = outs[b]
+                if s == bs and e == be:
+                    parts.append(piece)
+                else:
+                    parts.append(jax.lax.slice(piece, (s - bs,), (e - bs,)))
+        if not parts:
+            flat = jnp.zeros((0,), jnp.float32)
+        elif len(parts) == 1:
+            flat = parts[0]
+        else:
+            flat = jnp.concatenate(parts)
+        leaves.append(flat.reshape(shape).astype(dtype))
+    return jax.tree_util.tree_unflatten(layout.treedef, leaves)
+
+
+def readiness_bucket_order(
+    plan: BucketPlan,
+    layout: Optional[TreeLayout] = None,
+    leaf_rank: Optional[Sequence[int]] = None,
+) -> Tuple[int, ...]:
+    """Bucket dispatch order for the pipelined wire: the bucket whose
+    LAST-ready constituent gradient becomes available earliest goes
+    first.
+
+    ``leaf_rank[i]`` is the production rank of leaf ``i``'s gradient in
+    the backward pass (smaller = produced earlier). The default rank is
+    REVERSE construction order — backprop produces the last-constructed
+    layers' gradients first — which for the contiguous canonical layout
+    reduces to reversed bucket enumeration (the last bucket holds the
+    last leaves). ``parallel/overlap.grad_leaf_readiness`` extracts the
+    real production order from a traced jaxpr; tests pin that the
+    default rank agrees with it on the real models, and callers with an
+    exotic model can pass the measured rank instead."""
+    if layout is None or leaf_rank is None:
+        return tuple(reversed(range(plan.n_buckets)))
+    segs = bucket_leaf_segments(layout, plan)
+    n_leaves = len(layout.shapes)
+    ready = []
+    for b, frags in enumerate(segs):
+        ranks = [
+            leaf_rank[idx] for idx, _, _ in frags
+            if idx is not None and idx < n_leaves
+        ]
+        # a bucket of pure padding is ready immediately
+        ready.append((max(ranks) if ranks else -1, b))
+    return tuple(b for _, b in sorted(ready))
+
+
 def concat_buckets(buckets: Sequence[jax.Array]) -> jax.Array:
     return jnp.concatenate(list(buckets))
 
@@ -280,7 +410,8 @@ serialization.register_serialization_state(
 
 
 def piece_stream(tree, bucket_bytes, align: int = 1,
-                 flat_output: bool = False):
+                 flat_output: bool = False, pipelined: bool = False,
+                 bucket_output: bool = False):
     """The comm engine's one entry point: what a collective scheme ships.
 
     Returns ``(pieces, key_ids, rebuild)``:
@@ -304,7 +435,30 @@ def piece_stream(tree, bucket_bytes, align: int = 1,
       f32 vector in the same ``align`` geometry, skipping the per-leaf
       scatter entirely. The pieces (and therefore the wire) are
       IDENTICAL either way — flat_output changes only the rebuild.
-    """
+
+    ``pipelined=True`` (PSConfig.overlap="pipelined", bucketed wires
+    only) keeps the SAME plan, the same leaf->bucket byte assignment,
+    and the same start-offset PRNG ids — so every piece's VALUES are
+    bit-identical to the serial stream — but changes the dataflow and
+    the enumeration:
+
+    - each bucket is assembled from its own leaves' fragments
+      (``assemble_bucket``), never by slicing a global concat, so bucket
+      b's reduction depends only on the gradients whose bytes live in b;
+    - pieces stream in READINESS order (``readiness_bucket_order``:
+      last-constructed leaves backprop first, so the last bucket
+      dispatches first) — reverse-topological bucket enumeration;
+    - the tree rebuild gathers each leaf from its own buckets
+      (``leaves_from_buckets``) instead of slicing the full concat.
+
+    ``bucket_output=True`` (pipelined flat state: the consumer is the
+    PER-BUCKET vector update) makes ``rebuild`` return the list of
+    per-bucket f32 aggregates in CANONICAL bucket order instead of any
+    concatenation — the one spelling with no whole-vector barrier at
+    all. Requires a bucketed wire."""
+    if bucket_output and bucket_bytes is None:
+        raise ValueError("bucket_output needs a bucketed wire "
+                         "(bucket_bytes is None = per-leaf)")
     if bucket_bytes is None:
         leaves, treedef = jax.tree_util.tree_flatten(tree)
         if flat_output:
@@ -329,10 +483,29 @@ def piece_stream(tree, bucket_bytes, align: int = 1,
         )
     layout = tree_layout(tree)
     plan = plan_buckets(layout.total, bucket_bytes, align=align)
+    if pipelined:
+        order = readiness_bucket_order(plan)
+        segs = bucket_leaf_segments(layout, plan)
+        leaves = jax.tree_util.tree_leaves(tree)
+        pieces = [assemble_bucket(leaves, segs[b]) for b in order]
+        key_ids = tuple(plan.starts[b] for b in order)
+
+        def rebuild(outs):
+            canon = [None] * plan.n_buckets
+            for b, o in zip(order, outs):
+                canon[b] = o
+            if bucket_output:
+                return canon
+            if flat_output:
+                return concat_buckets(canon)
+            return leaves_from_buckets(layout, plan, canon)
+
+        return (pieces, key_ids, rebuild)
     pieces = split_buckets(pad_flat(tree_to_flat(tree), plan), plan)
-    rebuild = (
-        concat_buckets
-        if flat_output
-        else (lambda outs: flat_to_tree(layout, concat_buckets(outs)))
-    )
+    if bucket_output:
+        rebuild = lambda outs: list(outs)
+    elif flat_output:
+        rebuild = concat_buckets
+    else:
+        rebuild = lambda outs: flat_to_tree(layout, concat_buckets(outs))
     return (pieces, plan.starts, rebuild)

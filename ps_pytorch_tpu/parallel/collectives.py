@@ -19,6 +19,7 @@ Reference semantics being reproduced (see SURVEY.md section 3.2):
 
 from __future__ import annotations
 
+import contextlib
 from typing import Optional
 
 import jax
@@ -73,9 +74,20 @@ def aggregation_mask(
     raise ValueError(f"unknown aggregation mode {mode!r}")
 
 
+def _bucket_scope(pipelined: bool, key_id):
+    """Named scope for one bucket's reduce chain (pipelined mode only):
+    the per-bucket span names (``bucket_reduce_o<start offset>``) that
+    profiler timelines and tools/trace_report.py's overlap analysis key
+    on. Serial mode stays scope-free so its lowering is untouched."""
+    if not pipelined:
+        return contextlib.nullcontext()
+    return jax.named_scope(f"bucket_reduce_o{int(key_id)}")
+
+
 def psum_mean(tree, axis_name: str, denominator: float,
               bucket_bytes: Optional[int] = None,
-              flat_output: bool = False):
+              flat_output: bool = False, pipelined: bool = False,
+              bucket_output: bool = False):
     """Sum over workers / denominator (parity: _model_update divides the
     aggregate buffer by num_aggregate, sync_replicas_master_nn.py:204-207).
 
@@ -86,13 +98,29 @@ def psum_mean(tree, axis_name: str, denominator: float,
     ``flat_output`` (state_layout="flat") returns the aggregate as one
     padded flat vector instead of scattering it back into the tree; the
     collectives themselves are identical (jax batches a whole-tree psum
-    into one eqn either way)."""
+    into one eqn either way).
+
+    ``pipelined`` (PSConfig.overlap) emits ONE psum eqn per bucket, in
+    readiness order, over buckets assembled from their own leaves — same
+    buckets, same bytes, bit-identical values, but each bucket's reduce
+    is dataflow-independent of the rest of the backward so a
+    latency-hiding scheduler can overlap them (serial's fused psum over
+    the global concat cannot start until every gradient exists).
+    ``bucket_output`` returns the canonical-order list of per-bucket
+    aggregates for the per-bucket vector update."""
     if bucket_bytes is None and not flat_output:
         summed = lax.psum(tree, axis_name)
         return jax.tree_util.tree_map(lambda g: g / denominator, summed)
-    pieces, _, rebuild = piece_stream(
-        tree, bucket_bytes, flat_output=flat_output
+    pieces, key_ids, rebuild = piece_stream(
+        tree, bucket_bytes, flat_output=flat_output, pipelined=pipelined,
+        bucket_output=bucket_output,
     )
+    if pipelined:
+        outs = []
+        for i, g in zip(key_ids, pieces):
+            with _bucket_scope(True, i):
+                outs.append(lax.psum(g, axis_name) / denominator)
+        return rebuild(outs)
     summed = lax.psum(pieces, axis_name)  # one fused eqn over the buckets
     return rebuild([s / denominator for s in summed])
 
@@ -106,6 +134,8 @@ def quantized_psum(
     key: Optional[jax.Array] = None,
     bucket_bytes: Optional[int] = None,
     flat_output: bool = False,
+    pipelined: bool = False,
+    bucket_output: bool = False,
 ):
     """int8-quantized gradient all-reduce.
 
@@ -144,9 +174,14 @@ def quantized_psum(
         return deq / denominator
 
     pieces, key_ids, rebuild = piece_stream(
-        tree, bucket_bytes, align=block_size or 1, flat_output=flat_output
+        tree, bucket_bytes, align=block_size or 1, flat_output=flat_output,
+        pipelined=pipelined, bucket_output=bucket_output,
     )
-    return rebuild([one(i, g) for i, g in zip(key_ids, pieces)])
+    outs = []
+    for i, g in zip(key_ids, pieces):
+        with _bucket_scope(pipelined, i):
+            outs.append(one(i, g))
+    return rebuild(outs)
 
 
 def _slice_len(total: int, n: int, block_size: int) -> int:
@@ -218,6 +253,8 @@ def quantized_allreduce_2round(
     key: Optional[jax.Array] = None,
     bucket_bytes: Optional[int] = None,
     flat_output: bool = False,
+    pipelined: bool = False,
+    bucket_output: bool = False,
 ):
     """Two-round int8 all-reduce whose WIRE traffic is actually int8.
 
@@ -267,9 +304,14 @@ def quantized_allreduce_2round(
         return (deq[:total] / denominator).reshape(g.shape)
 
     pieces, key_ids, rebuild = piece_stream(
-        tree, bucket_bytes, align=block_size or 1, flat_output=flat_output
+        tree, bucket_bytes, align=block_size or 1, flat_output=flat_output,
+        pipelined=pipelined, bucket_output=bucket_output,
     )
-    return rebuild([one(i, g) for i, g in zip(key_ids, pieces)])
+    outs = []
+    for i, g in zip(key_ids, pieces):
+        with _bucket_scope(pipelined, i):
+            outs.append(one(i, g))
+    return rebuild(outs)
 
 
 def quantized_allreduce_2round_hier(
@@ -282,6 +324,8 @@ def quantized_allreduce_2round_hier(
     key: Optional[jax.Array] = None,
     bucket_bytes: Optional[int] = None,
     flat_output: bool = False,
+    pipelined: bool = False,
+    bucket_output: bool = False,
 ):
     """Hierarchical (DCN x ICI) bandwidth-honest int8 all-reduce that
     crosses DCN exactly ONCE per gradient element.
@@ -344,9 +388,14 @@ def quantized_allreduce_2round_hier(
         return (full[:total] / denominator).reshape(g.shape)
 
     pieces, key_ids, rebuild = piece_stream(
-        tree, bucket_bytes, align=block_size or 1, flat_output=flat_output
+        tree, bucket_bytes, align=block_size or 1, flat_output=flat_output,
+        pipelined=pipelined, bucket_output=bucket_output,
     )
-    return rebuild([one(i, g) for i, g in zip(key_ids, pieces)])
+    outs = []
+    for i, g in zip(key_ids, pieces):
+        with _bucket_scope(pipelined, i):
+            outs.append(one(i, g))
+    return rebuild(outs)
 
 
 def local_quantized_contribution(
@@ -356,6 +405,7 @@ def local_quantized_contribution(
     rounding: str = "nearest",
     key: Optional[jax.Array] = None,
     bucket_bytes: Optional[int] = None,
+    pipelined: bool = False,
 ):
     """What THIS worker's gradient becomes after its (shared-scale) int8
     round trip — the transmitted value whose difference from the true
@@ -383,7 +433,7 @@ def local_quantized_contribution(
         )
 
     pieces, key_ids, rebuild = piece_stream(
-        grads, bucket_bytes, align=block_size or 1
+        grads, bucket_bytes, align=block_size or 1, pipelined=pipelined
     )
     return rebuild([one(i, g) for i, g in zip(key_ids, pieces)])
 
@@ -403,6 +453,8 @@ def aggregate_gradients(
     axis_sizes: Optional[tuple] = None,
     bucket_bytes: Optional[int] = None,
     flat_output: bool = False,
+    pipelined: bool = False,
+    bucket_output: bool = False,
 ):
     """The full PS aggregation: mask -> (bucket) -> (quantized) reduce -> / K.
 
@@ -462,7 +514,8 @@ def aggregate_gradients(
     denom = k if dynamic else float(k)
     if compress in (None, "none"):
         agg = psum_mean(grads, axis_name, denom,
-                        bucket_bytes=bucket_bytes, flat_output=flat_output)
+                        bucket_bytes=bucket_bytes, flat_output=flat_output,
+                        pipelined=pipelined, bucket_output=bucket_output)
         contribution = grads  # lossless transmit: residual is zero
     elif compress == "int8":
         agg = quantized_psum(
@@ -474,6 +527,8 @@ def aggregate_gradients(
             key=quant_key,
             bucket_bytes=bucket_bytes,
             flat_output=flat_output,
+            pipelined=pipelined,
+            bucket_output=bucket_output,
         )
         contribution = None
     elif hier_2round:
@@ -492,6 +547,8 @@ def aggregate_gradients(
             key=quant_key,
             bucket_bytes=bucket_bytes,
             flat_output=flat_output,
+            pipelined=pipelined,
+            bucket_output=bucket_output,
         )
         contribution = None
     elif compress == "int8_2round":
@@ -505,6 +562,8 @@ def aggregate_gradients(
             key=quant_key,
             bucket_bytes=bucket_bytes,
             flat_output=flat_output,
+            pipelined=pipelined,
+            bucket_output=bucket_output,
         )
         contribution = None
     else:
@@ -529,5 +588,6 @@ def aggregate_gradients(
             rounding=quant_rounding,
             key=contrib_key,
             bucket_bytes=bucket_bytes,
+            pipelined=pipelined,
         )
     return agg, contribution

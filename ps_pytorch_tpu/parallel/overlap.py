@@ -1,0 +1,226 @@
+"""Schedule analysis for the pipelined bucket wire (PSConfig.overlap).
+
+Two questions, answered from traced jaxprs (CPU-only, nothing executes):
+
+1. **In what order do gradient leaves become ready?**
+   ``grad_leaf_readiness`` walks the jaxpr of a gradient computation and
+   returns, per output leaf, the position of the equation that produces
+   it — the backward's production order. Backprop runs the forward graph
+   in reverse, so the LAST-constructed parameters' gradients are
+   produced FIRST; ``buckets.readiness_bucket_order`` encodes exactly
+   that (reverse bucket enumeration over the canonical flat layout), and
+   tests pin the two against each other on the real models. The engine
+   uses the static order (no extra trace per step build); this module is
+   the measurement that justifies it.
+
+2. **How much freedom does the schedule have around each collective?**
+   ``jaxpr_overlap_headroom`` finds the (deepest) jaxpr carrying the
+   gradient-reduce collectives, builds the equation-level dataflow
+   graph, and reports two numbers per reduce-kind collective:
+   ``independent_frac`` — the equation weight that is neither ancestor
+   nor descendant, i.e. schedulable CONCURRENTLY with the collective —
+   and ``prefix_frac`` — the ancestor weight that MUST retire before
+   the collective can launch. The serial wire concatenates every leaf
+   before carving buckets, so each collective's ancestor cone swallows
+   the whole backward (every prefix is the same large value and no
+   gradient compute may run beside the wire); the pipelined wire's
+   per-bucket assembly gives the first readiness-ordered bucket a
+   prefix of just its own leaves' chain and leaves the other buckets'
+   compute independent. These are properties of the PROGRAM (what a
+   latency-hiding scheduler is allowed to do), not wall-clock
+   measurements (what one backend's scheduler actually did);
+   ``tools/trace_report.py overlap trace`` over a TPU profile measures
+   the latter.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Optional, Sequence, Tuple
+
+# reduce-style collective primitive names (mirrors check/walker.py's
+# REDUCE_KINDS without importing the static-analysis layer into the
+# engine package)
+_REDUCE_PRIMS = ("psum", "reduce_scatter", "psum_scatter", "all_to_all")
+
+_CALL_KEYS = ("jaxpr", "call_jaxpr", "fun_jaxpr", "cond_jaxpr", "body_jaxpr")
+
+
+def _open(j):
+    return getattr(j, "jaxpr", j)
+
+
+def _sub_jaxprs(eqn):
+    out = []
+    for key in _CALL_KEYS:
+        sub = eqn.params.get(key)
+        if sub is not None:
+            out.append(_open(sub))
+    for br in eqn.params.get("branches", ()) or ():
+        out.append(_open(br))
+    return out
+
+
+def _is_var(v) -> bool:
+    return hasattr(v, "aval") and not hasattr(v, "val")
+
+
+# ------------------------------------------------------------- readiness
+
+def _linearize(jaxpr, prod: Dict[Any, int], counter: List[int]) -> None:
+    """Depth-first global enumeration of equations; record each var's
+    producing position. Call-like sub-jaxprs enumerate in place (their
+    outputs map onto the eqn's outvars), which is exact enough for a
+    production ORDER: jaxpr equations are already topologically
+    sorted, so position is a valid readiness rank."""
+    for eqn in jaxpr.eqns:
+        subs = _sub_jaxprs(eqn)
+        for sub in subs:
+            _linearize(sub, prod, counter)
+            # map sub outputs onto the call eqn's outputs so a leaf
+            # produced inside a pjit still gets its inner position
+            for ov, iv in zip(eqn.outvars, sub.outvars):
+                if _is_var(ov) and _is_var(iv) and iv in prod:
+                    prod[ov] = prod[iv]
+        counter[0] += 1
+        for v in eqn.outvars:
+            if _is_var(v) and v not in prod:
+                prod[v] = counter[0]
+
+
+def grad_leaf_readiness(fn, *example_args) -> Tuple[int, ...]:
+    """Production rank of each flat output leaf of ``fn`` (typically a
+    ``jax.grad`` of the loss): smaller = that leaf's value is produced
+    by an earlier equation of the traced jaxpr. ``example_args`` may be
+    ShapeDtypeStructs — nothing executes."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*example_args)
+    jaxpr = _open(closed)
+    prod: Dict[Any, int] = {}
+    _linearize(jaxpr, prod, [0])
+    ranks = []
+    for v in jaxpr.outvars:
+        ranks.append(prod.get(v, 0) if _is_var(v) else 0)
+    return tuple(ranks)
+
+
+# ------------------------------------------------------- overlap headroom
+
+def _total_eqns(jaxpr) -> int:
+    n = 0
+    for eqn in jaxpr.eqns:
+        n += 1
+        for sub in _sub_jaxprs(eqn):
+            n += _total_eqns(sub)
+    return n
+
+
+def _find_collective_jaxpr(jaxpr):
+    """The deepest jaxpr that itself contains reduce-kind collective
+    eqns — for the PS engine that is the shard_map body, where the
+    backward, the per-bucket reduces, and the update are sibling
+    equations of one graph."""
+    for eqn in jaxpr.eqns:
+        for sub in _sub_jaxprs(eqn):
+            found = _find_collective_jaxpr(sub)
+            if found is not None:
+                return found
+    for eqn in jaxpr.eqns:
+        if eqn.primitive.name in _REDUCE_PRIMS:
+            return jaxpr
+    return None
+
+
+def jaxpr_overlap_headroom(fn, *example_args) -> dict:
+    """Schedule-freedom report for the traced step ``fn(*example_args)``.
+
+    For every reduce-kind collective equation in the (deepest) jaxpr
+    that carries them: ``independent_frac`` = weight of equations that
+    are neither dataflow ancestors nor descendants of it, over the total
+    equation weight (sub-jaxpr bodies weigh as their internal equation
+    count). Returns ``{n_collectives, total_weight, per_collective:
+    [{name, independent_frac, ...}], overlap_headroom}`` where
+    ``overlap_headroom`` is the mean independent fraction — 0 means
+    every collective is a full barrier (nothing may run beside it), the
+    serial grad->psum->update shape; the pipelined wire's per-bucket
+    chains push it up."""
+    import jax
+
+    closed = jax.make_jaxpr(fn)(*example_args)
+    body = _find_collective_jaxpr(_open(closed))
+    if body is None:
+        return {"n_collectives": 0, "total_weight": 0,
+                "per_collective": [], "overlap_headroom": None}
+    eqns = list(body.eqns)
+    weights = [1 + sum(_total_eqns(s) for s in _sub_jaxprs(e)) for e in eqns]
+    total = sum(weights)
+    # producer map: var -> eqn index; consumer adjacency
+    prod: Dict[Any, int] = {}
+    for i, e in enumerate(eqns):
+        for v in e.outvars:
+            if _is_var(v):
+                prod[v] = i
+    parents: List[List[int]] = []
+    for e in eqns:
+        ps = sorted({
+            prod[v] for v in e.invars if _is_var(v) and v in prod
+        })
+        parents.append(ps)
+    children: List[List[int]] = [[] for _ in eqns]
+    for i, ps in enumerate(parents):
+        for p in ps:
+            children[p].append(i)
+
+    def cone(start: int, adj: List[List[int]]) -> set:
+        seen = {start}
+        stack = [start]
+        while stack:
+            x = stack.pop()
+            for y in adj[x]:
+                if y not in seen:
+                    seen.add(y)
+                    stack.append(y)
+        return seen
+
+    coll_idx = [
+        i for i, e in enumerate(eqns) if e.primitive.name in _REDUCE_PRIMS
+    ]
+    per = []
+    for i in coll_idx:
+        e = eqns[i]
+        anc = cone(i, parents)
+        desc = cone(i, children)
+        dependent = anc | desc  # includes the collective itself
+        independent = total - sum(weights[j] for j in dependent)
+        prefix = sum(weights[j] for j in anc - {i})
+        per.append({
+            "eqn": i,
+            "prim": e.primitive.name,
+            # what MAY run while this collective is in flight
+            "independent_weight": independent,
+            "independent_frac": round(independent / total, 4) if total else 0,
+            # what MUST retire before this collective can start — the
+            # pipelining number: the serial schedule's global concat
+            # forces every bucket to wait for the whole backward, so
+            # every prefix is the same large value; the pipelined wire's
+            # first (readiness-ordered) bucket needs only its own
+            # leaves' chain
+            "prefix_frac": round(prefix / total, 4) if total else 0,
+        })
+    frac = (
+        round(sum(p["independent_frac"] for p in per) / len(per), 4)
+        if per else None
+    )
+    prefixes = sorted(p["prefix_frac"] for p in per)
+    return {
+        "n_collectives": len(per),
+        "total_weight": total,
+        "per_collective": per,
+        "overlap_headroom": frac,
+        # earliest/mean dispatch depth: fraction of the program that
+        # gates the first (resp. average) collective's launch
+        "first_dispatch_prefix": prefixes[0] if prefixes else None,
+        "mean_dispatch_prefix": (
+            round(sum(prefixes) / len(prefixes), 4) if prefixes else None
+        ),
+    }

@@ -55,10 +55,14 @@ from ..resilience.guard import (
 from .buckets import (
     BucketPlan,
     FlatVector,
+    assemble_bucket,
+    bucket_leaf_segments,
     concat_buckets,
     flat_to_tree,
+    leaves_from_buckets,
     pad_flat,
     plan_buckets,
+    readiness_bucket_order,
     to_flat_vector,
     tree_layout,
     tree_to_flat,
@@ -129,6 +133,23 @@ class PSConfig:
     # checkpoints are tree-shaped at the save/restore boundary, so
     # they stay bit-portable across both settings.
     state_layout: str = "flat"
+    # WHEN the wire moves (--overlap on|off): "serial" (default) reduces
+    # after the whole backward — the committed-contract baseline schedule.
+    # "pipelined" launches each bucket's collective as soon as its
+    # leaves' gradients exist: buckets are assembled from their own leaf
+    # fragments (no global-concat false dependency), streamed in
+    # readiness order (reverse-topological bucket enumeration: the last
+    # bucket's leaves backprop first), reduced by per-bucket collective
+    # eqns, and — under state_layout="flat" — consumed by PER-BUCKET
+    # optimizer updates as reductions land, so XLA's latency-hiding
+    # scheduler can interleave the wire with the remaining backward AND
+    # the update. Same buckets, same bytes, bit-identical values (PRNG
+    # keys fold bucket START OFFSETS, so the reordered enumeration draws
+    # identical noise; PSC109 pins byte equality against the serial
+    # twin). The per-bucket update requires elementwise optimizer
+    # transforms with per-parameter state (the repo's sgd/adam families;
+    # a global-norm-coupled transform would need the whole vector).
+    overlap: str = "serial"
     # error feedback (EF-SGD): each worker keeps the residual its
     # compression dropped and adds it back next step, so quantization
     # error accumulates into the update instead of being lost — the
@@ -191,6 +212,27 @@ class PSConfig:
             raise ValueError(f"bad quant_rounding {self.quant_rounding!r}")
         if self.state_layout not in ("tree", "flat"):
             raise ValueError(f"bad state_layout {self.state_layout!r}")
+        if self.overlap not in ("serial", "pipelined"):
+            raise ValueError(
+                f"bad overlap {self.overlap!r} (serial | pipelined)"
+            )
+        if (
+            self.overlap == "pipelined"
+            and self.bucket_bytes is None
+            and self.opt_placement != "sharded"
+        ):
+            # the pipelined schedule is a property of the BUCKETED wire;
+            # on the replicated per-leaf wire it would silently un-fuse
+            # the whole-tree psum back into one eqn per leaf (the exact
+            # shape bucketing exists to avoid). The ZeRO-1 wire is flat
+            # by construction (None == one fused bucket there), so it
+            # pipelines fine without the knob.
+            raise ValueError(
+                "overlap='pipelined' needs a bucketed wire: set "
+                "bucket_bytes (0 = one fused buffer, N = ~N-byte "
+                "buckets) — the replicated per-leaf wire has no buckets "
+                "to stream"
+            )
         if self.bucket_bytes is not None and self.bucket_bytes < 0:
             raise ValueError(
                 f"bad bucket_bytes {self.bucket_bytes} (None = per-leaf, "
@@ -489,6 +531,143 @@ def _worker_region(flat, plan: BucketPlan, w, n: int):
     return concat_buckets(parts) if len(parts) > 1 else parts[0]
 
 
+# ------------------------------------------------ per-bucket vector update
+# (overlap="pipelined": the optimizer starts as each bucket's reduction
+# lands, instead of waiting for the whole aggregate to concatenate)
+
+def _is_flatvec(x) -> bool:
+    return isinstance(x, FlatVector)
+
+
+def _strip_flat(tree):
+    """Replace every FlatVector node with its bare padded buffer, so the
+    per-bucket slices feed tree- and flat-form optimizer transforms
+    alike (a tree_map over mixed FlatVector/bare operands would reject
+    the structure)."""
+    return jax.tree_util.tree_map(
+        lambda x: x.flat if _is_flatvec(x) else x, tree, is_leaf=_is_flatvec
+    )
+
+
+def _rewrap_flat(template, bare):
+    """Inverse of ``_strip_flat``: restore the template's FlatVector
+    wrappers (their static layout/plan metadata) around the stitched
+    bare buffers, so the step's output state structure is unchanged."""
+    return jax.tree_util.tree_map(
+        lambda t, v: t.replace(flat=v) if _is_flatvec(t) else v,
+        template, bare, is_leaf=_is_flatvec,
+    )
+
+
+def _bucket_opt_views(opt_bare, seg_len: int):
+    """(leaves, treedef, is_seg): flatten a bare optimizer state and mark
+    which leaves are per-parameter vectors of ``seg_len`` elements (the
+    moment buffers — sliced per bucket) vs scalars like the step count
+    (replicated into every bucket's update unchanged)."""
+    leaves, treedef = jax.tree_util.tree_flatten(opt_bare)
+    is_seg = [
+        getattr(l, "ndim", None) == 1 and int(l.shape[0]) == seg_len
+        for l in leaves
+    ]
+    return leaves, treedef, is_seg
+
+
+def _stitch_opt(treedef, per_bucket_leaves, is_seg, first_bucket: int):
+    """Reassemble the whole-vector optimizer state from per-bucket
+    updates: segment leaves concatenate in CANONICAL bucket order,
+    scalar leaves (every bucket computed the identical count+1) come
+    from the first-dispatched bucket."""
+    first = per_bucket_leaves[first_bucket]
+    out = []
+    for j, seg in enumerate(is_seg):
+        if seg:
+            out.append(jnp.concatenate(
+                [pb[j] for pb in per_bucket_leaves]
+            ))
+        else:
+            out.append(first[j])
+    return jax.tree_util.tree_unflatten(treedef, out)
+
+
+def _pipelined_flat_update(tx, agg_buckets, opt_state, params: FlatVector,
+                           plan: BucketPlan):
+    """Replicated flat-state update, one ``tx.update`` per bucket: bucket
+    b's new params/moments depend only on bucket b's aggregate, so the
+    update chain for an early-reduced bucket can run while later buckets
+    are still on the wire. Bit-exact vs the whole-vector update for
+    elementwise transforms (the repo's sgd/adam families): slicing an
+    elementwise chain commutes with it, and every bucket reads the same
+    input ``count``. Returns (new_params, new_opt)."""
+    opt_bare = _strip_flat(opt_state)
+    leaves, treedef, is_seg = _bucket_opt_views(opt_bare, plan.padded_total)
+    order = readiness_bucket_order(plan)
+    new_p = [None] * plan.n_buckets
+    new_opt = [None] * plan.n_buckets
+    for b in order:
+        start, size = plan.starts[b], plan.sizes[b]
+        with jax.named_scope(f"bucket_update_o{start}"):
+            p_b = lax.slice(params.flat, (start,), (start + size,))
+            opt_b = jax.tree_util.tree_unflatten(treedef, [
+                lax.slice(l, (start,), (start + size,)) if seg else l
+                for l, seg in zip(leaves, is_seg)
+            ])
+            u_b, opt_b_new = tx.update(agg_buckets[b], opt_b, p_b)
+            new_p[b] = p_b + _strip_flat(u_b)
+            new_opt[b] = jax.tree_util.tree_leaves(_strip_flat(opt_b_new))
+    stitched = _stitch_opt(treedef, new_opt, is_seg, order[0])
+    return (
+        params.replace(flat=concat_buckets(new_p)),
+        _rewrap_flat(opt_state, stitched),
+    )
+
+
+def _shard_reduce_bucket(bucket, size: int, axis, n: int, w, k, cfg,
+                         bkey, want_contrib: bool):
+    """One bucket of the ZeRO-1 wire: (quantize) -> psum_scatter / int8
+    all_to_all -> THIS worker's dequantized 1/n shard divided by the
+    aggregation count. Shared by the serial and pipelined schedules so
+    the per-bucket transform (and therefore the bytes and the values)
+    can never diverge between them. Returns ``(g_shard [size//n],
+    contribution [size] or None)``."""
+    s = size // n
+    bsz = cfg.quant_block_size
+    if cfg.compress in ("int8", "int8_2round"):
+        q, scale = quantize_int8(
+            bucket,
+            axis_name=axis,
+            block_size=bsz,
+            rounding=cfg.quant_rounding,
+            key=bkey,
+        )
+        contrib = None
+        if want_contrib:
+            # what the wire carries after the int8 round trip — the
+            # residual is everything it dropped (incl. the whole
+            # gradient on mask-excluded steps: sent==0 -> q==0 ->
+            # contribution 0)
+            contrib = dequantize_int8(
+                q.astype(jnp.int32), scale, block_size=bsz, shape=(size,)
+            )
+        if cfg.compress == "int8":
+            sb = lax.psum_scatter(
+                q.reshape(-1).astype(jnp.int32), axis, tiled=True
+            )
+        else:
+            q8 = q.reshape(n, s).astype(jnp.int8)
+            recv = lax.all_to_all(
+                q8, axis, split_axis=0, concat_axis=0, tiled=True
+            )
+            sb = jnp.sum(recv.astype(jnp.int32), axis=0)  # [s]
+        if bsz:
+            nb_loc = s // bsz
+            my_scales = lax.dynamic_slice(scale, (w * nb_loc, 0), (nb_loc, 1))
+            return (
+                sb.reshape(nb_loc, bsz).astype(jnp.float32) * my_scales
+            ).reshape(-1) / k, contrib
+        return dequantize_int8(sb, scale) / k, contrib
+    return lax.psum_scatter(bucket, axis, tiled=True) / k, None
+
+
 def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key,
                        quant_key=None, err=None, agg_count=None):
     """ZeRO-1 "sharded PS": (EF add-back) -> mask -> (quantize) ->
@@ -536,78 +715,53 @@ def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key,
     layout = tree_layout(grads)
     total = layout.total
     plan = _sharded_plan(cfg, total)
-    flat_g = pad_flat(tree_to_flat(grads), plan)
-    if err is not None:
-        flat_g = flat_g + err
+    w = lax.axis_index(axis)
+    if (
+        cfg.compress in ("int8", "int8_2round")
+        and cfg.quant_rounding == "stochastic"
+        and quant_key is not None
+    ):
+        quant_key = jax.random.fold_in(quant_key, w)
+
+    def bucket_key(start):
+        return (
+            jax.random.fold_in(quant_key, start)
+            if quant_key is not None
+            and cfg.compress in ("int8", "int8_2round")
+            else None
+        )
+
+    sel = None
     if dynamic or k != n:
         sel = aggregation_mask(
             axis, n, agg_count if dynamic else cfg.num_aggregate,
             mask_key, cfg.mask_mode,
         )
-        sent = flat_g * sel
-    else:
-        sent = flat_g
+
+    if cfg.overlap == "pipelined":
+        return _sharded_ps_update_pipelined(
+            params, opt_state, grads, tx, cfg, layout, plan, w, k, sel,
+            bucket_key, err,
+        )
+
+    flat_g = pad_flat(tree_to_flat(grads), plan)
+    if err is not None:
+        flat_g = flat_g + err
+    sent = flat_g * sel if sel is not None else flat_g
     new_err = None
-    bsz = cfg.quant_block_size
-    w = lax.axis_index(axis)
-    if cfg.compress in ("int8", "int8_2round"):
-        if cfg.quant_rounding == "stochastic" and quant_key is not None:
-            quant_key = jax.random.fold_in(quant_key, w)
-        g_shards, contribs = [], []
-        for start, size in zip(plan.starts, plan.sizes):
-            bucket = lax.slice(sent, (start,), (start + size,))
-            s = size // n
-            bkey = (
-                jax.random.fold_in(quant_key, start)
-                if quant_key is not None
-                else None
-            )
-            q, scale = quantize_int8(
-                bucket,
-                axis_name=axis,
-                block_size=bsz,
-                rounding=cfg.quant_rounding,
-                key=bkey,
-            )
-            if err is not None:
-                # what the wire carries after the int8 round trip — the
-                # residual is everything it dropped (incl. the whole
-                # gradient on mask-excluded steps: sent==0 -> q==0 ->
-                # contribution 0)
-                contribs.append(dequantize_int8(
-                    q.astype(jnp.int32), scale, block_size=bsz,
-                    shape=(size,),
-                ))
-            if cfg.compress == "int8":
-                sb = lax.psum_scatter(
-                    q.reshape(-1).astype(jnp.int32), axis, tiled=True
-                )
-            else:
-                q8 = q.reshape(n, s).astype(jnp.int8)
-                recv = lax.all_to_all(
-                    q8, axis, split_axis=0, concat_axis=0, tiled=True
-                )
-                sb = jnp.sum(recv.astype(jnp.int32), axis=0)  # [s]
-            if bsz:
-                nb_loc = s // bsz
-                my_scales = lax.dynamic_slice(
-                    scale, (w * nb_loc, 0), (nb_loc, 1)
-                )
-                g_shards.append((
-                    sb.reshape(nb_loc, bsz).astype(jnp.float32) * my_scales
-                ).reshape(-1) / k)
-            else:
-                g_shards.append(dequantize_int8(sb, scale) / k)
-        g_shard = concat_buckets(g_shards)
-        if err is not None:
-            new_err = flat_g - concat_buckets(contribs)
-    else:
-        g_shard = concat_buckets([
-            lax.psum_scatter(
-                lax.slice(sent, (start,), (start + size,)), axis, tiled=True
-            )
-            for start, size in zip(plan.starts, plan.sizes)
-        ]) / k
+    g_shards, contribs = [], []
+    for start, size in zip(plan.starts, plan.sizes):
+        bucket = lax.slice(sent, (start,), (start + size,))
+        g_b, contrib = _shard_reduce_bucket(
+            bucket, size, axis, n, w, k, cfg, bucket_key(start),
+            want_contrib=err is not None,
+        )
+        g_shards.append(g_b)
+        if contrib is not None:
+            contribs.append(contrib)
+    g_shard = concat_buckets(g_shards)
+    if err is not None:
+        new_err = flat_g - concat_buckets(contribs)
     if isinstance(params, FlatVector):
         flat_p = params.flat  # already padded in this plan's geometry
     else:
@@ -633,6 +787,90 @@ def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key,
             params, flat_to_tree(layout, upd_full)
         )
     return new_params, new_opt, new_err
+
+
+def _sharded_ps_update_pipelined(params, opt_state, grads, tx, cfg, layout,
+                                 plan, w, k, sel, bucket_key, err):
+    """The ZeRO-1 update as a per-bucket stream (overlap="pipelined"):
+    every bucket is assembled from its own gradient leaves
+    (``assemble_bucket`` — no global ``tree_to_flat`` concat, so bucket
+    b's chain depends only on its leaves' gradients), reduced via the
+    SAME ``_shard_reduce_bucket`` transform as the serial schedule,
+    updated on its own shard segment, and gathered back — all in
+    readiness order, so an early bucket's scatter/update/gather can
+    overlap the rest of the backward. Values and bytes are identical to
+    the serial schedule; only the dataflow (and therefore what a
+    latency-hiding scheduler may interleave) changes."""
+    axis, n = cfg.axis_name, cfg.num_workers
+    segs = bucket_leaf_segments(layout, plan)
+    order = readiness_bucket_order(plan)
+    g_leaves = jax.tree_util.tree_leaves(grads)
+    p_is_flat = isinstance(params, FlatVector)
+    p_leaves = None if p_is_flat else jax.tree_util.tree_leaves(params)
+    shard_len = plan.padded_total // n
+    opt_bare = _strip_flat(opt_state)
+    opt_leaves, opt_def, is_seg = _bucket_opt_views(opt_bare, shard_len)
+    # canonical per-bucket offsets into the worker's shard
+    shard_off = []
+    off = 0
+    for size in plan.sizes:
+        shard_off.append(off)
+        off += size // n
+    nb = plan.n_buckets
+    new_p = [None] * nb
+    new_opt = [None] * nb
+    err_parts = [None] * nb
+    upd_full = [None] * nb
+    for b in order:
+        start, size = plan.starts[b], plan.sizes[b]
+        s = size // n
+        with jax.named_scope(f"bucket_reduce_o{start}"):
+            g_b = assemble_bucket(g_leaves, segs[b])
+            if err is not None:
+                g_b = g_b + lax.slice(err, (start,), (start + size,))
+            sent_b = g_b * sel if sel is not None else g_b
+            g_shard_b, contrib = _shard_reduce_bucket(
+                sent_b, size, axis, n, w, k, cfg, bucket_key(start),
+                want_contrib=err is not None,
+            )
+            if err is not None:
+                err_parts[b] = g_b - contrib
+        with jax.named_scope(f"bucket_update_o{start}"):
+            if p_is_flat:
+                p_b = lax.dynamic_slice(
+                    params.flat, (start + w * s,), (s,)
+                )
+            else:
+                p_b = lax.dynamic_slice(
+                    assemble_bucket(p_leaves, segs[b]), (w * s,), (s,)
+                )
+            opt_b = jax.tree_util.tree_unflatten(opt_def, [
+                lax.slice(l, (shard_off[b],), (shard_off[b] + s,))
+                if seg else l
+                for l, seg in zip(opt_leaves, is_seg)
+            ])
+            u_b, opt_b_new = tx.update(g_shard_b, opt_b, p_b)
+            gathered = lax.all_gather(_strip_flat(u_b), axis, tiled=True)
+            if p_is_flat:
+                new_p[b] = (
+                    lax.slice(params.flat, (start,), (start + size,))
+                    + gathered
+                )
+            else:
+                upd_full[b] = gathered
+            new_opt[b] = jax.tree_util.tree_leaves(_strip_flat(opt_b_new))
+    stitched = _stitch_opt(opt_def, new_opt, is_seg, order[0])
+    new_opt_state = _rewrap_flat(opt_state, stitched)
+    if p_is_flat:
+        new_params = params.replace(flat=concat_buckets(new_p))
+    else:
+        # per-leaf rebuild of the gathered updates — each leaf waits on
+        # its own buckets only (the pipelined mirror of flat_to_tree)
+        new_params = optax.apply_updates(
+            params, leaves_from_buckets(layout, plan, upd_full)
+        )
+    new_err = concat_buckets(err_parts) if err is not None else None
+    return new_params, new_opt_state, new_err
 
 
 def make_ps_train_step(
@@ -830,6 +1068,13 @@ def make_ps_train_step(
                 err = tree_map(lambda a: a[0], comm_state)
                 grads = tree_map(jnp.add, grads, err)
             is_flat = cfg.state_layout == "flat"
+            pipelined = cfg.overlap == "pipelined"
+            # pipelined x flat x bucketed: the aggregate stays a LIST of
+            # per-bucket vectors so the optimizer can start per bucket —
+            # the only spelling with no whole-vector barrier at all
+            bucket_out = (
+                pipelined and is_flat and cfg.bucket_bytes is not None
+            )
             out = aggregate_gradients(
                 grads,
                 axis,
@@ -846,7 +1091,9 @@ def make_ps_train_step(
                 return_contribution=cfg.error_feedback,
                 axis_sizes=hier_sizes,
                 bucket_bytes=cfg.bucket_bytes,
-                flat_output=is_flat,
+                flat_output=is_flat and not bucket_out,
+                pipelined=pipelined,
+                bucket_output=bucket_out,
             )
             if cfg.error_feedback:
                 # the contribution (and the residual it defines) stays
@@ -856,13 +1103,23 @@ def make_ps_train_step(
                 new_comm = tree_map(lambda a: a[None], new_err)
             else:
                 agg = out
-            if is_flat:
-                # the reduced flat gradient, already in the state's
-                # BucketPlan geometry (piece_stream and state_plan share
-                # wire_align) — wrap it and run ONE fused vector update
-                agg = params.replace(flat=agg)
-            updates, new_opt = tx.update(agg, opt_state, params)
-            params = optax.apply_updates(params, updates)
+            if bucket_out:
+                # per-bucket fused vector updates, dispatched as each
+                # bucket's reduction lands (state_plan and the wire share
+                # one BucketPlan, so the per-bucket aggregates drop
+                # straight onto the state's own carving)
+                params, new_opt = _pipelined_flat_update(
+                    tx, agg, opt_state, params, params.plan
+                )
+            else:
+                if is_flat:
+                    # the reduced flat gradient, already in the state's
+                    # BucketPlan geometry (piece_stream and state_plan
+                    # share wire_align) — wrap it and run ONE fused
+                    # vector update
+                    agg = params.replace(flat=agg)
+                updates, new_opt = tx.update(agg, opt_state, params)
+                params = optax.apply_updates(params, updates)
 
         if cfg.bn_mode == "local":
             out_bs = tree_map(lambda a: a[None], new_bs)

@@ -276,7 +276,12 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
     from ..ops.flash_attention import flash_partial
 
     n = lax.axis_size(axis_name)
-    me = lax.axis_index(axis_name)
+    # global positions are only consumed by the causal mask; without it,
+    # deriving the shard offsets from lax.axis_index would strand a
+    # partition-id op on the kernel's (then-unused) SMEM offsets operand,
+    # which XLA's SPMD partitioner refuses to place (the ring_flash-bidir
+    # CPU failure) — so the non-causal ring simply doesn't ask where it is
+    me = lax.axis_index(axis_name) if causal else 0
     b, t_loc, h, d = q.shape
     if scale is None:
         scale = 1.0 / (d ** 0.5)
@@ -290,7 +295,8 @@ def _ring_flash_fwd(q, k, v, axis_name, causal, scale, block_q, block_k,
 
     def partial_at(k_c, v_c, blk_idx):
         return flash_partial(
-            q3, k_c, v_c, scale, causal, q_off, blk_idx * t_loc,
+            q3, k_c, v_c, scale, causal, q_off,
+            blk_idx * t_loc if causal else 0,
             block_q, block_k,
         )
 
@@ -354,7 +360,9 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k,
     b, t_loc, h, d = do.shape  # static shape/dtype info rides on the cotangent
     in_dtype = do.dtype
     n = lax.axis_size(axis_name)
-    me = lax.axis_index(axis_name)
+    # same rule as _ring_flash_fwd: only the causal mask consumes global
+    # positions, and a dead axis_index strands an unplaceable partition-id
+    me = lax.axis_index(axis_name) if causal else 0
     if scale is None:
         scale = 1.0 / (d ** 0.5)
     do3 = _fold_heads(do).astype(q3.dtype)
@@ -368,7 +376,7 @@ def _ring_flash_vjp_bwd(axis_name, causal, scale, block_q, block_k,
     def grads_at(k_c, v_c, blk_idx):
         return flash_grads_partial(
             q3, k_c, v_c, do3, lse, delta, scale, causal,
-            q_off, blk_idx * t_loc, block_q, block_k,
+            q_off, blk_idx * t_loc if causal else 0, block_q, block_k,
         )
 
     if not bidirectional or n <= 2:

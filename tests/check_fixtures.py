@@ -22,6 +22,9 @@ shaped so every rule's failure mode exists somewhere runnable:
 - adaptive_fat_wire: declares an adaptive-mask envelope smaller than
                   the gradient psum actually moves — the
                   bytes-per-count regression PSC108 exists for
+- depipelined:    declares OverlapPolicy(mode="pipelined") over a
+                  4-bucket plan but reduces everything in ONE fused
+                  psum — the silent re-serialization PSC109 exists for
 - ok_psum:        fully clean (the negative control)
 """
 
@@ -42,6 +45,7 @@ from ps_pytorch_tpu.check import (
     DonationSpec,
     FusionSpec,
     GradReduce,
+    OverlapPolicy,
     ServePolicy,
     WireAllowance,
     WirePolicy,
@@ -323,6 +327,25 @@ def _adaptive_fat_wire() -> ContractSpec:
     )
 
 
+def _depipelined() -> ContractSpec:
+    # a healthy fused step (grad psum feeds params, axis consumed, no
+    # donation declared) whose contract CLAIMS a pipelined 4-bucket
+    # schedule: the single fused psum is under the PSC106 budget
+    # (1 <= 4 + slack) but fails PSC109's per-bucket dispatch demand —
+    # the silent re-serialization the rule exists for. No serial twin is
+    # traced beside it, so the byte pin defers to PSC104 and exactly the
+    # dispatch finding fires.
+    L = 32
+    return ContractSpec(
+        name="depipelined",
+        build=lambda: _built(_clean_step(donate=False), L),
+        axes=(AXIS,),
+        grad_reduce=(GradReduce(AXIS, ("psum",)),),
+        fusion=FusionSpec(payload_bytes=L * 4, bucket_bytes=L),  # 4 buckets
+        overlap=OverlapPolicy(mode="pipelined", serial_twin=None),
+    )
+
+
 def _ok_psum() -> ContractSpec:
     return ContractSpec(
         name="ok_psum",
@@ -345,5 +368,6 @@ def get_contracts():
         _serve_chatty(),
         _serve_f32_kv(),
         _adaptive_fat_wire(),
+        _depipelined(),
         _ok_psum(),
     )

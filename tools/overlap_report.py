@@ -12,6 +12,10 @@ ways (most → least direct):
             run and measure wall-clock overlap between collective and compute
             events on the device timeline. Needs a device that emits an
             op-level timeline (TPU; the CPU backend logs host events only).
+            Knows the pipelined wire's per-bucket span names
+            (`bucket_reduce_o<offset>` / `bucket_update_o<offset>`,
+            jax.named_scope from parallel/collectives.py) and reports a
+            per-bucket overlap breakdown when they appear.
   topology  AOT-compile the SPMD train step for an N-chip TPU topology via
             `jax.experimental.topologies` (no chips needed — the compiler
             does the scheduling) and analyze the compiled schedule.
@@ -21,6 +25,18 @@ ways (most → least direct):
             scheduled after backward — a property of XLA:CPU, not of the
             engine; this mode exists to exercise the analyzer and to show
             the HLO the partitioner emits.
+  jaxpr     trace the step (nothing compiles or executes) and measure the
+            SCHEDULE FREEDOM the program's dataflow grants, per gradient
+            reduce: `independent_frac` (equation weight that is neither
+            ancestor nor descendant — what a latency-hiding scheduler MAY
+            place beside the collective; `overlap_fraction` is its mean)
+            and `prefix_frac` (ancestor weight — what MUST retire before
+            the collective can launch). The pipelined wire (--overlap on)
+            raises the former and collapses the latter: serially, the
+            global flatten makes every bucket wait for the whole
+            backward; pipelined, the first readiness-ordered bucket
+            launches after its own leaves' chain alone. Deterministic and
+            backend-independent — the number to bank from a CPU container.
 
 Schedule analysis: in a scheduled HLO module the textual instruction order
 of the entry computation IS the execution order. For every async collective
@@ -298,6 +314,10 @@ def _build_step(args, mesh, dcn_hosts: int = 1):
         compress=args.compress,
         num_aggregate=args.num_aggregate,
         dcn_hosts=dcn_hosts,  # >1 needs a make_hybrid_mesh-shaped mesh
+        bucket_bytes=(
+            None if args.bucket_bytes < 0 else args.bucket_bytes
+        ),
+        overlap="pipelined" if args.overlap == "on" else "serial",
     )
     net = build_model(args.network, num_classes=10)
     tx = sgd(0.1, momentum=0.9)
@@ -326,6 +346,37 @@ def run_hlo(args) -> dict:
     rep["mode"] = "hlo"
     rep["backend"] = jax.default_backend()
     rep["workers"] = args.workers
+    return rep
+
+
+def run_jaxpr(args) -> dict:
+    """Schedule-freedom from the traced step's dataflow (trace-only, no
+    compile): parallel/overlap.jaxpr_overlap_headroom over the real
+    train step built with this CLI's config (--overlap selects the
+    schedule). overlap_headroom ~0 = every collective is a barrier."""
+    import jax
+
+    from ps_pytorch_tpu.parallel.mesh import make_mesh
+    from ps_pytorch_tpu.parallel.overlap import jaxpr_overlap_headroom
+
+    mesh = make_mesh(num_workers=args.workers)
+    step, state, batch = _build_step(args, mesh)
+    rep = jaxpr_overlap_headroom(step, state, batch, jax.random.key(1))
+    # keep the report compact: per-collective rows collapse to stats
+    fracs = sorted(
+        p["independent_frac"] for p in rep.pop("per_collective")
+    )
+    rep["overlap_fraction"] = rep["overlap_headroom"]  # the headline
+    rep.update({
+        "mode": "jaxpr",
+        "workers": args.workers,
+        "network": args.network,
+        "compress": args.compress,
+        "overlap": args.overlap,
+        "bucket_bytes": args.bucket_bytes,
+        "independent_frac_min": fracs[0] if fracs else None,
+        "independent_frac_max": fracs[-1] if fracs else None,
+    })
     return rep
 
 
@@ -405,7 +456,11 @@ def run_trace(args) -> dict:
         k in n.lower()
         for k in ("all-reduce", "all_reduce", "allreduce", "all-gather",
                   "all_gather", "reduce-scatter", "reduce_scatter",
-                  "collective", "all-to-all", "psum")
+                  "collective", "all-to-all", "psum",
+                  # the pipelined wire's per-bucket named_scope spans
+                  # (parallel/collectives.py): ops under these scopes ARE
+                  # the bucket's reduce chain
+                  "bucket_reduce_o")
     )
     # compute = real op events only (fusion/conv/dot/elementwise families),
     # NOT every non-collective span: infra/marker events (barriers, infeed,
@@ -481,6 +536,45 @@ def run_trace(args) -> dict:
     cm, pm = _merge(coll), _merge(comp)
     coll_time = sum(t - s for s, t in cm)
     overlap = _inter(cm, pm)
+    # per-bucket breakdown when the pipelined wire's named scopes appear
+    # on the device timeline: each bucket's own overlapped fraction.
+    # ONLY the reduce scopes define a bucket's comm interval, and only
+    # the SAME bucket's reduce/update spans are excluded from the
+    # compute set it intersects — a bucket's own optimizer ops must not
+    # count as phantom self-overlap, but ANOTHER bucket's update running
+    # during this bucket's reduce is exactly the overlap the per-bucket
+    # update path exists to create and must be counted.
+    bucket_any_re = re.compile(r"bucket_(?:reduce|update)_o(\d+)")
+    bucket_reduce_re = re.compile(r"bucket_reduce_o(\d+)")
+    per_bucket = {}
+    for e in spans:
+        m = bucket_reduce_re.search(e["name"])
+        if not m:
+            continue
+        per_bucket.setdefault(int(m.group(1)), []).append(
+            (e["ts"], e["ts"] + e["dur"])
+        )
+
+    def _comp_offset(e):
+        m = bucket_any_re.search(e["name"])
+        return int(m.group(1)) if m else None
+
+    comp_tagged = [(e, _comp_offset(e)) for e in comp_events]
+    bucket_rows = []
+    for off in sorted(per_bucket):
+        bm = _merge(per_bucket[off])
+        bt = sum(t - s for s, t in bm)
+        pm_other = _merge([
+            (e["ts"], e["ts"] + e["dur"])
+            for e, tag in comp_tagged if tag != off
+        ])
+        ov = _inter(bm, pm_other)
+        bucket_rows.append({
+            "bucket_offset": off,
+            "ms": round(bt / 1e3, 3),
+            "overlapped_ms": round(ov / 1e3, 3),
+            "overlap_fraction": round(ov / bt, 4) if bt else None,
+        })
     return {
         "mode": "trace",
         "trace_file": pats[-1],
@@ -495,6 +589,8 @@ def run_trace(args) -> dict:
         "collective_ms": round(coll_time / 1e3, 3),
         "overlapped_ms": round(overlap / 1e3, 3),
         "overlap_fraction": round(overlap / coll_time, 4) if coll_time else None,
+        # the pipelined wire's per-bucket spans, when present
+        "per_bucket": bucket_rows or None,
         # name breakdowns so the fraction is auditable: what counted as
         # compute, and what was excluded as infra/markers
         "top_compute_events": _top_names(comp_events),
@@ -504,21 +600,26 @@ def run_trace(args) -> dict:
 
 def main(argv=None):
     p = argparse.ArgumentParser(description=__doc__)
-    p.add_argument("mode", choices=["hlo", "trace", "topology"])
+    p.add_argument("mode", choices=["hlo", "trace", "topology", "jaxpr"])
     p.add_argument("--workers", type=int, default=8)
     p.add_argument("--network", default="ResNet18")
     p.add_argument("--dataset", default="Cifar10")
     p.add_argument("--batch", type=int, default=64)
     p.add_argument("--compress", default=None)
     p.add_argument("--num-aggregate", type=int, default=None)
+    p.add_argument("--bucket-bytes", type=int, default=-1,
+                   help="gradient wire granularity (-1 = per-leaf, 0 = "
+                        "one fused buffer, N = ~N-byte buckets)")
+    p.add_argument("--overlap", choices=["on", "off"], default="off",
+                   help="build the step with the pipelined bucket "
+                        "schedule (PSConfig.overlap)")
     p.add_argument("--profile-dir", default=None)
     p.add_argument("--topology", default=None)
     p.add_argument("--out", default=None)
     args = p.parse_args(argv)
 
-    rep = {"hlo": run_hlo, "trace": run_trace, "topology": run_topology}[
-        args.mode
-    ](args)
+    rep = {"hlo": run_hlo, "trace": run_trace, "topology": run_topology,
+           "jaxpr": run_jaxpr}[args.mode](args)
     print(json.dumps(rep, indent=2))
     if args.out:
         if os.path.dirname(args.out):

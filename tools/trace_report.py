@@ -26,8 +26,11 @@ monotonic clock. This tool:
 
 The earlier one-off analysis tools fold in as subcommands:
 
-  python tools/trace_report.py overlap <hlo|trace|topology> [...]
-      -> tools/overlap_report.py (comm/compute overlap evidence)
+  python tools/trace_report.py overlap <hlo|trace|topology|jaxpr> [...]
+      -> tools/overlap_report.py (comm/compute overlap evidence;
+         `jaxpr --overlap on|off` reports the pipelined wire's
+         schedule-freedom numbers, `trace` knows the per-bucket
+         `bucket_reduce_o<offset>` span names — §6g)
   python tools/trace_report.py window [outdir]
       -> tools/window_report.py (TPU bench-window rollup)
 
