@@ -767,6 +767,36 @@ def _validate_env() -> None:
         )
 
 
+def _backend_info(device_kind) -> dict:
+    """The measuring backend's identity, stamped on every record (and on
+    every A/B variant sub-record): BENCH_r05 banked CPU-fallback numbers
+    that were indistinguishable from TPU evidence — platform + device
+    kind make the provenance part of the artifact, and
+    ``_require_same_backend`` refuses to compute a speedup across
+    mismatched ones."""
+    try:
+        import jax
+
+        platform = jax.default_backend()
+    except Exception:  # error-record path on a broken env: stay emittable
+        platform = None
+    return {
+        "platform": platform,
+        "device_kind": str(device_kind) if device_kind else None,
+    }
+
+
+def _require_same_backend(*variants: dict) -> None:
+    """Refuse a mixed-backend A/B: a speedup of a TPU leg over a CPU
+    (or fallback) leg is not a measurement of anything. ONE policy —
+    the tune subsystem's (autotune probes enforce the same refusal) —
+    so the two checks can never drift; a variant missing its stamp
+    counts as a distinct (unknown) backend."""
+    from ps_pytorch_tpu.tune.search import require_same_backend
+
+    require_same_backend([v.get("backend") or {} for v in variants])
+
+
 def _run_info(n_devices, device_kind) -> dict:
     """The self-describing run block every bench record carries (obs/
     schema.py): run id + schema version + the measured geometry, so a
@@ -888,6 +918,7 @@ def main() -> None:
             "vs_baseline": round(tokens_per_sec / REF_IMAGES_PER_SEC, 2),
             "mfu": _mfu(flops, steps, elapsed, jax, n_devices=lm_dev),
             "device": device_kind,
+            "backend": _backend_info(device_kind),
             "timestamp": _utc_now(),
             "hlo_op_count": hlo_ops,
             # comm shape rides only the PS (CNN) records — the lm
@@ -924,6 +955,7 @@ def main() -> None:
             "vs_baseline": None,
             "mfu": None,  # decode is KV-cache-bandwidth-bound by design
             "device": device_kind,
+            "backend": _backend_info(device_kind),
             "timestamp": _utc_now(),
             "hlo_op_count": dec_hlo_ops,
             "comm": None,  # serving path: no gradient wire at all
@@ -950,6 +982,7 @@ def main() -> None:
             "vs_baseline": None,  # no serving counterpart in the reference
             "mfu": None,  # open-loop serving is latency-bound by design
             "device": device_kind,
+            "backend": _backend_info(device_kind),
             "timestamp": _utc_now(),
             "hlo_op_count": srv_hlo_ops,
             # the serving wire is PINNED silent (PSC107) — attach the
@@ -1094,6 +1127,7 @@ def main() -> None:
             "step_time_s": round(elapsed / steps, 6),
             "bucket_bytes": bucket_bytes,
             "state_layout": state_layout,
+            "backend": _backend_info(device_kind),
             "hlo_op_count": hlo_ops,
             # leg walltime breakdown: compile+settle vs measured window
             "phases": {
@@ -1131,6 +1165,7 @@ def main() -> None:
         ab_bb = 0 if ab_bb is None else ab_bb
         sub_leaf, *_ = run_variant(None)
         sub_bkt, loss, elapsed, steps, flops, k = run_variant(ab_bb)
+        _require_same_backend(sub_leaf, sub_bkt)
         images_per_sec = sub_bkt["images_per_sec"]
         rec = {
             "run": _run_info(n_dev, device_kind),
@@ -1141,6 +1176,7 @@ def main() -> None:
             "vs_baseline": round(images_per_sec / REF_IMAGES_PER_SEC, 2),
             "mfu": _mfu(flops, steps, elapsed, jax, n_devices=n_dev),
             "device": device_kind,
+            "backend": _backend_info(device_kind),
             "timestamp": _utc_now(),
             "hlo_op_count": sub_bkt["hlo_op_count"],
             # schema stability: every record carries "comm"; the A/B
@@ -1168,6 +1204,7 @@ def main() -> None:
         sub_flat, loss, elapsed, steps, flops, k = run_variant(
             bb, state_layout="flat", probe_update_path=True
         )
+        _require_same_backend(sub_tree, sub_flat)
         images_per_sec = sub_flat["images_per_sec"]
         rec = {
             "run": _run_info(n_dev, device_kind),
@@ -1178,6 +1215,7 @@ def main() -> None:
             "vs_baseline": round(images_per_sec / REF_IMAGES_PER_SEC, 2),
             "mfu": _mfu(flops, steps, elapsed, jax, n_devices=n_dev),
             "device": device_kind,
+            "backend": _backend_info(device_kind),
             "timestamp": _utc_now(),
             "hlo_op_count": sub_flat["hlo_op_count"],
             "comm": sub_flat["comm"],
@@ -1219,6 +1257,7 @@ def main() -> None:
         sub_pip, loss, elapsed, steps, flops, k = run_variant(
             bb, overlap="pipelined", probe_overlap=True, spans=True
         )
+        _require_same_backend(sub_ser, sub_pip)
         images_per_sec = sub_pip["images_per_sec"]
         rec = {
             "run": _run_info(n_dev, device_kind),
@@ -1229,6 +1268,7 @@ def main() -> None:
             "vs_baseline": round(images_per_sec / REF_IMAGES_PER_SEC, 2),
             "mfu": _mfu(flops, steps, elapsed, jax, n_devices=n_dev),
             "device": device_kind,
+            "backend": _backend_info(device_kind),
             "timestamp": _utc_now(),
             "hlo_op_count": sub_pip["hlo_op_count"],
             "comm": sub_pip["comm"],
@@ -1256,6 +1296,7 @@ def main() -> None:
             "vs_baseline": round(images_per_sec / REF_IMAGES_PER_SEC, 2),
             "mfu": _mfu(flops, steps, elapsed, jax, n_devices=n_dev),
             "device": device_kind,
+            "backend": _backend_info(device_kind),
             "timestamp": _utc_now(),
             "step_time_s": sub["step_time_s"],
             "hlo_op_count": sub["hlo_op_count"],

@@ -231,20 +231,37 @@ _PAYLOAD_CACHE: dict = {}
 
 def payload_bytes(network: str) -> int:
     if network not in _PAYLOAD_CACHE:
-        import jax
-
-        from ..models import build_model, init_model
-
-        model = build_model(network, num_classes=10)
-        params, _ = jax.eval_shape(
-            lambda: init_model(
-                model, jax.random.key(0), (1,) + _NETWORK_HW[network]
-            )
-        )
-        _PAYLOAD_CACHE[network] = 4 * sum(
-            int(_prod(l.shape)) for l in jax.tree_util.tree_leaves(params)
-        )
+        _PAYLOAD_CACHE[network] = _model_bytes(network)
     return _PAYLOAD_CACHE[network]
+
+
+def bn_state_bytes(network: str) -> int:
+    """f32 bytes of the model's non-parameter state (BatchNorm running
+    stats) — the payload the default ``bn_mode="pmean"`` averages across
+    workers each step. Derived from the real init's eval_shape, like
+    ``payload_bytes``, so the PSC103 allowance below can never desync
+    from the model. 0 for BN-free networks (LeNet)."""
+    key = (network, "bn")
+    if key not in _PAYLOAD_CACHE:
+        _PAYLOAD_CACHE[key] = _model_bytes(network, state=True)
+    return _PAYLOAD_CACHE[key]
+
+
+def _model_bytes(network: str, state: bool = False) -> int:
+    import jax
+
+    from ..models import build_model, init_model
+
+    model = build_model(network, num_classes=10)
+    out = jax.eval_shape(
+        lambda: init_model(
+            model, jax.random.key(0), (1,) + _NETWORK_HW[network]
+        )
+    )
+    tree = out[1] if state else out[0]
+    return 4 * sum(
+        int(_prod(l.shape)) for l in jax.tree_util.tree_leaves(tree)
+    )
 
 
 def _prod(shape) -> int:
@@ -303,6 +320,7 @@ def _ps_spec(
     adaptive: bool = False,
     overlap: str = "serial",
     bucket_tag: str = "",
+    quant_block_size: int = 0,
 ) -> ContractSpec:
     from ..parallel.mesh import DCN_AXIS, WORKER_AXIS
 
@@ -316,6 +334,12 @@ def _ps_spec(
         # different carving of the same scheme (e.g. the 64 KiB
         # multi-bucket PSC109 twins vs the fused "_bucketed" entries)
         name += "_bucketed" + bucket_tag
+    if quant_block_size:
+        # block-scale granularity changes the scale-row accounting (and
+        # can overflow PSC103's scale allowances — the tune/ search uses
+        # exactly that as a pruning constraint), so it must be visible
+        # in the config name
+        name += f"_qb{quant_block_size}"
     if adaptive:
         name += "_adaptive"
     if overlap == "pipelined":
@@ -341,6 +365,7 @@ def _ps_spec(
             bucket_bytes=bucket_bytes,
             state_layout=state_layout,
             overlap=overlap,
+            quant_block_size=quant_block_size,
             num_aggregate_min=2 if adaptive else None,
             num_aggregate_max=MESH_DEVICES if adaptive else None,
         )
@@ -362,6 +387,18 @@ def _ps_spec(
     wire = None
     if compress == "int8_2round":
         allow = [_METRICS_PSUM, _SCALE_PMAX, _SCALE_GATHER, _FINITE_PMIN]
+        if bn_state_bytes(network):
+            # BatchNorm running stats (bn_mode="pmean", the default)
+            # ride an f32 psum sized by the model's own state tree —
+            # statistics, not gradient payload, so they are allowed on
+            # a compressed wire. BN-free registry networks (LeNet)
+            # never declare this, so committed entries are unchanged.
+            allow.append(WireAllowance(
+                kind="psum", dtype="float32",
+                max_bytes=bn_state_bytes(network),
+                reason="BatchNorm cross-replica stats pmean "
+                       "(bn_mode=pmean; model state, not gradients)",
+            ))
         if placement == "sharded":
             allow.append(
                 WireAllowance(

@@ -51,6 +51,13 @@ class TraceResult:
     )                                 # (path, dtype) of the KV pool arg,
                                       # collected when spec.serve is set
                                       # (PSC107 storage-dtype policy)
+    closed: Any = None                # the traced ClosedJaxpr, retained
+                                      # only when trace_spec(keep_jaxpr=
+                                      # True) — the tune/ cost model
+                                      # derives update-path ops and
+                                      # overlap headroom from the SAME
+                                      # trace the rules ran on, instead
+                                      # of re-tracing per probe
 
 
 def _tree_leaves_with_none(tree):
@@ -94,8 +101,12 @@ def _donation_info(built, spec: ContractSpec) -> Tuple[int, int, List[str]]:
     return marks, donated, mismatches
 
 
-def trace_spec(spec: ContractSpec) -> TraceResult:
-    """Trace one contract's real step and measure its collectives."""
+def trace_spec(spec: ContractSpec, keep_jaxpr: bool = False) -> TraceResult:
+    """Trace one contract's real step and measure its collectives.
+
+    ``keep_jaxpr=True`` retains the ClosedJaxpr on the result so
+    downstream consumers (tune/costmodel.py) can run further jaxpr-level
+    analyses without paying a second trace."""
     import jax
 
     built = spec.build()
@@ -128,6 +139,7 @@ def trace_spec(spec: ContractSpec) -> TraceResult:
         donated_leaves=donated,
         donation_mismatches=mismatches,
         kv_leaves=kv_leaves,
+        closed=closed if keep_jaxpr else None,
     )
 
 

@@ -134,6 +134,11 @@ def update_path_op_count(fn, *args) -> int:
     Traces only (ShapeDtypeStruct args are fine); nothing executes."""
     import jax
 
-    closed = jax.make_jaxpr(fn)(*args)
+    return update_path_ops_from(jax.make_jaxpr(fn)(*args))
+
+
+def update_path_ops_from(closed) -> int:
+    """``update_path_op_count`` over an already-traced ClosedJaxpr (the
+    tune/ cost model reuses the trace pscheck's rules ran on)."""
     count, _ = _forward_count(_open(closed), set())
     return count

@@ -16,13 +16,20 @@ Multi-device smoke (8 virtual CPU devices):
 from __future__ import annotations
 
 import argparse
+import sys
 
 import jax
 
 from ..parallel import initialize_multihost
 from ..trainer import Trainer
 from ..utils import get_logger
-from ._flags import add_ps_flags, add_train_flags, ps_config_from, train_config_from
+from ._flags import (
+    add_ps_flags,
+    add_train_flags,
+    expand_config_json,
+    ps_config_from,
+    train_config_from,
+)
 
 logger = get_logger()
 
@@ -31,6 +38,19 @@ def main(argv=None) -> dict:
     parser = argparse.ArgumentParser("ps_pytorch_tpu.cli.train")
     add_train_flags(parser)
     add_ps_flags(parser)
+    parser.add_argument(
+        "--config-json", metavar="FILE",
+        help="apply a tuned knob set from an autotune evidence record "
+             "(tools/autotune.py output; the best candidate's flags) or "
+             "a bare {flag: value} JSON object. Unknown keys and flags "
+             "that also appear explicitly on the command line are "
+             "rejected (see cli/_flags.expand_config_json)",
+    )
+    # --config-json expands into real argv tokens BEFORE parsing, so the
+    # file's values ride the parser's own types/choices validation
+    argv = expand_config_json(
+        parser, list(sys.argv[1:] if argv is None else argv)
+    )
     args = parser.parse_args(argv)
 
     initialize_multihost(

@@ -146,7 +146,13 @@ def jaxpr_overlap_headroom(fn, *example_args) -> dict:
     chains push it up."""
     import jax
 
-    closed = jax.make_jaxpr(fn)(*example_args)
+    return overlap_headroom_from(jax.make_jaxpr(fn)(*example_args))
+
+
+def overlap_headroom_from(closed) -> dict:
+    """``jaxpr_overlap_headroom`` over an ALREADY-TRACED ClosedJaxpr —
+    the tune/ cost model analyzes the same trace pscheck's rules ran on
+    instead of paying a second trace per candidate."""
     body = _find_collective_jaxpr(_open(closed))
     if body is None:
         return {"n_collectives": 0, "total_weight": 0,

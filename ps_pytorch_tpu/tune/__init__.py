@@ -1,0 +1,42 @@
+"""Self-tuning subsystem (ARCHITECTURE §7h): pick the wire/schedule/
+layout knobs from evidence instead of operator folklore.
+
+Three layers:
+
+- ``costmodel``: a trace-only analytical cost model — for any candidate
+  ``PSConfig``, wire bytes + collective counts (check/walker.py), the
+  update-path op count (check/opcount.py), and schedule freedom
+  (parallel/overlap.py) combine with a declared hardware profile into a
+  modeled step time. CPU-only, seconds per candidate, nothing executes.
+- ``search``: the knob-grid driver — candidates are validated by the
+  PSC101-109 contract rules BEFORE they are costed (broken configs are
+  pruned with the finding attached, never crashed on), survivors are
+  ranked by modeled cost, and the top-K can optionally run short
+  measured probes whose span-derived overlap fractions feed back into
+  the model.
+- ``tools/autotune.py``: the operator CLI; emits a ranked, schema-
+  validated ``runs/autotune_<model>.json`` evidence record plus a
+  ready-to-paste flag line that ``cli/train --config-json`` applies.
+"""
+
+from .costmodel import (
+    CandidateCost,
+    HardwareProfile,
+    comm_seconds_from_rows,
+    load_hardware_profile,
+    model_cost,
+    modeled_step_seconds,
+)
+from .search import build_grid, Knobs, run_search
+
+__all__ = [
+    "CandidateCost",
+    "HardwareProfile",
+    "Knobs",
+    "build_grid",
+    "comm_seconds_from_rows",
+    "load_hardware_profile",
+    "model_cost",
+    "modeled_step_seconds",
+    "run_search",
+]

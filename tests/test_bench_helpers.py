@@ -327,3 +327,23 @@ def test_peak_flops_unknown_kind_returns_none(bench):
         device_kind = "cpu"
 
     assert bench._peak_flops_per_sec(Cpu()) is None
+
+
+def test_backend_info_stamps_platform_and_device_kind(bench):
+    info = bench._backend_info("TPU v5 lite")
+    assert info["device_kind"] == "TPU v5 lite"
+    assert info["platform"] == "cpu"  # the test env's live backend
+    assert bench._backend_info(None)["device_kind"] is None
+
+
+def test_require_same_backend_refuses_mixed_ab_variants(bench):
+    """BENCH_r05 banked CPU-fallback numbers indistinguishable from TPU
+    evidence; an A/B speedup across backends must refuse, not report."""
+    cpu = {"backend": {"platform": "cpu", "device_kind": "cpu"}}
+    tpu = {"backend": {"platform": "tpu", "device_kind": "TPU v5 lite"}}
+    bench._require_same_backend(cpu, dict(cpu))  # like-for-like: fine
+    with pytest.raises(SystemExit, match="across backends"):
+        bench._require_same_backend(cpu, tpu)
+    # a variant missing the stamp counts as a distinct (unknown) backend
+    with pytest.raises(SystemExit, match="across backends"):
+        bench._require_same_backend(cpu, {})

@@ -404,3 +404,42 @@ def test_predicted_scaling_contract_cross_check():
     bad[0]["by_kind"]["all-to-all"] = {"count": 1, "bytes": 1}
     report = contract_cross_check(bad, contract)
     assert report["ok"] is False
+
+
+# --------------------------------------------------- opcount coverage
+
+def test_update_path_opcount_serve_decode_is_zero():
+    """The serving decode step has NO gradient reduce (PSC107 pins zero
+    collectives), so its update-path op count — equations downstream of
+    a reduce-kind collective — must be exactly 0. Guards the opcount
+    walker against counting serving compute as update path."""
+    from ps_pytorch_tpu.check.contracts import _serve_spec
+    from ps_pytorch_tpu.check.opcount import update_path_op_count
+
+    built = _serve_spec(False).build()
+    assert update_path_op_count(built.step, *built.args) == 0
+
+
+def test_update_path_opcount_pipelined_zero1():
+    """The pipelined ZeRO-1 wire streams per-bucket scatter -> shard
+    update -> gather chains: every chain must land in the update-path
+    count (the satellite closing the 'only pinned on the ResNet18
+    replicated path' gap), and the from-closed helper must agree with
+    the tracing entry point on the same step."""
+    import jax
+
+    from ps_pytorch_tpu.check.contracts import _ps_spec
+    from ps_pytorch_tpu.check.opcount import (
+        update_path_op_count,
+        update_path_ops_from,
+    )
+
+    pip = _ps_spec("int8", "sharded", overlap="pipelined").build()
+    ser = _ps_spec("int8", "sharded").build()
+    n_pip = update_path_op_count(pip.step, *pip.args)
+    n_ser = update_path_op_count(ser.step, *ser.args)
+    assert n_pip > 0 and n_ser > 0
+    # the two entry points are one walker: tracing fn+args must equal
+    # walking an already-made jaxpr
+    closed = jax.make_jaxpr(pip.step)(*pip.args)
+    assert update_path_ops_from(closed) == n_pip

@@ -214,6 +214,11 @@ SAMPLE_EVENTS = {
                           "path": "/tmp/x", "error": "EIO"},
     "span": {"kind": "span", "name": "dispatch", "cat": "phase",
              "t": 1.25, "dur": 0.5, "depth": 0, "step": 3.0},
+    "autotune": {"kind": "autotune", "run": run_header("autotune"),
+                 "model": "lenet", "network": "LeNet", "grid": "tiny",
+                 "n_points": 7.0, "n_candidates": 5.0, "n_pruned": 2.0,
+                 "gate": {"min_modeled_speedup": None,
+                          "modeled_speedup": 1.0}},
 }
 
 
@@ -653,3 +658,21 @@ def test_trace_report_fractions_aggregate_across_hosts(tmp_path):
     # pooled: dispatch 0.2 of 0.5 total, sync 0.3 of 0.5
     assert frac["dispatch"] == pytest.approx(0.4)
     assert frac["sync"] == pytest.approx(0.6)
+
+
+def test_trace_report_require_phases_fails_on_dropped_spans(
+    tmp_path, capsys
+):
+    """A stream whose ring overflowed carries the spans_dropped meta
+    marker; the smoke gate (--require-phases) must refuse it — every
+    named phase being present proves nothing about a truncated
+    timeline. Without the gate flag the summary still renders."""
+    t = Tracer("train", path=str(tmp_path / "trace_t_p0.jsonl"), ring=2)
+    for _ in range(5):
+        with t.span("dispatch"):
+            pass
+    t.flush()
+    rc = trace_report.main([str(tmp_path), "--require-phases", "dispatch"])
+    assert rc == 1
+    assert "spans_dropped" in capsys.readouterr().err
+    assert trace_report.main([str(tmp_path)]) == 0

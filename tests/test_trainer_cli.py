@@ -5,6 +5,8 @@ These are the tests the reference never had for its role runtimes
 synthetic data, checkpoint round-trips, resume, and the polling evaluator
 consuming a trainer's checkpoints."""
 
+import json
+
 import numpy as np
 import pytest
 
@@ -471,3 +473,115 @@ def test_cli_tune_lm(monkeypatch):
     assert all(np.isfinite(v) for v in out.values())
     # the aggressive lr learns visibly more in 10 steps on the Markov chain
     assert out[0.2] < out[0.001]
+
+
+# ------------------------------------------------------- --config-json
+
+def _cli_parser():
+    import argparse
+
+    from ps_pytorch_tpu.cli._flags import add_ps_flags, add_train_flags
+
+    parser = argparse.ArgumentParser()
+    add_train_flags(parser)
+    add_ps_flags(parser)
+    parser.add_argument("--config-json")
+    return parser
+
+
+def test_config_json_applies_flags_through_the_parser(tmp_path):
+    from ps_pytorch_tpu.cli._flags import expand_config_json
+
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(json.dumps({
+        "--compress-grad": "compress", "--bucket-bytes": 65536,
+        "--overlap": "on", "--error-feedback": True,
+    }))
+    parser = _cli_parser()
+    argv = expand_config_json(
+        parser, ["--config-json", str(cfg), "--max-steps", "3"]
+    )
+    args = parser.parse_args(argv)
+    assert args.compress_grad == "compress"
+    assert args.bucket_bytes == 65536
+    assert args.overlap == "on"
+    assert args.error_feedback is True
+    assert args.max_steps == 3  # untouched flags pass through
+
+
+def test_config_json_extracts_best_flags_from_autotune_record(tmp_path):
+    from ps_pytorch_tpu.cli._flags import expand_config_json
+
+    rec = {
+        "kind": "autotune",
+        "best": {"flags": {"--compress-grad": "2round",
+                           "--bucket-bytes": 0}},
+    }
+    cfg = tmp_path / "rec.json"
+    cfg.write_text(json.dumps(rec))
+    parser = _cli_parser()
+    args = parser.parse_args(
+        expand_config_json(parser, [f"--config-json={cfg}"])
+    )
+    assert args.compress_grad == "2round" and args.bucket_bytes == 0
+
+
+def test_config_json_rejects_unknown_keys(tmp_path):
+    from ps_pytorch_tpu.cli._flags import expand_config_json
+
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(json.dumps({"--no-such-flag": 1}))
+    with pytest.raises(SystemExit, match="unknown flag"):
+        expand_config_json(_cli_parser(), ["--config-json", str(cfg)])
+
+
+def test_config_json_rejects_flag_conflicts(tmp_path):
+    from ps_pytorch_tpu.cli._flags import expand_config_json
+
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(json.dumps({"--compress-grad": "compress"}))
+    with pytest.raises(SystemExit, match="passed explicitly"):
+        expand_config_json(
+            _cli_parser(),
+            ["--config-json", str(cfg), "--compress-grad", "none"],
+        )
+    # conflicts are rejected even when the values agree: one owner per knob
+    with pytest.raises(SystemExit, match="passed explicitly"):
+        expand_config_json(
+            _cli_parser(),
+            ["--config-json", str(cfg), "--compress-grad", "compress"],
+        )
+
+
+def test_config_json_rejects_non_boolean_store_true(tmp_path):
+    from ps_pytorch_tpu.cli._flags import expand_config_json
+
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(json.dumps({"--error-feedback": "yes"}))
+    with pytest.raises(SystemExit, match="JSON boolean"):
+        expand_config_json(_cli_parser(), ["--config-json", str(cfg)])
+
+
+def test_config_json_pruned_record_with_no_best_is_actionable(tmp_path):
+    from ps_pytorch_tpu.cli._flags import expand_config_json
+
+    cfg = tmp_path / "rec.json"
+    cfg.write_text(json.dumps({"kind": "autotune", "best": None}))
+    with pytest.raises(SystemExit, match="no best candidate"):
+        expand_config_json(_cli_parser(), ["--config-json", str(cfg)])
+
+
+def test_config_json_conflict_detection_sees_abbreviated_flags(tmp_path):
+    """argparse resolves prefix abbreviations (--compress-g ->
+    --compress-grad); the conflict check must resolve them the same way
+    or an abbreviated explicit flag silently last-wins over the tuned
+    value."""
+    from ps_pytorch_tpu.cli._flags import expand_config_json
+
+    cfg = tmp_path / "cfg.json"
+    cfg.write_text(json.dumps({"--compress-grad": "2round"}))
+    with pytest.raises(SystemExit, match="passed explicitly"):
+        expand_config_json(
+            _cli_parser(),
+            ["--config-json", str(cfg), "--compress-g", "none"],
+        )

@@ -172,6 +172,34 @@ print("obs smoke: %d phases merged (train+serve), %d span events, "
       % (len(s["phases"]), len(spans), frac.get("dispatch", 0.0)))
 PYEOF
 
+# autotune leg (ARCHITECTURE §7h): trace-only knob search over the
+# trimmed LeNet grid on the 8-dev CPU mesh — candidates are pruned by
+# the PSC contract rules before costing (the grid deliberately contains
+# a config-invalid point AND a PSC103-pruned one), survivors ranked by
+# the trace-only cost model, and the evidence record must land with a
+# schema-valid run_header. Nothing executes; compiles are trace-only.
+run python tools/autotune.py --model lenet --grid smoke --trace-only \
+    --out "$TMP/autotune_lenet.json"
+run python - "$TMP/autotune_lenet.json" <<'PYEOF'
+import json, sys
+from ps_pytorch_tpu.obs.schema import validate_event
+rec = json.load(open(sys.argv[1]))
+validate_event(rec)                      # kind "autotune" round-trips
+validate_event(dict(rec["run"]))         # nested run_header is valid
+assert rec["run"]["component"] == "autotune", rec["run"]
+assert rec["trace_only"] and rec["n_candidates"] >= 8, rec["n_candidates"]
+costs = [c["cost"]["modeled_step_s"] for c in rec["candidates"]]
+assert costs == sorted(costs) and all(c > 0 for c in costs), costs[:3]
+stages = {p["stage"] for p in rec["pruned"]}
+assert "config" in stages, stages        # engine-refused combination
+contract = [p for p in rec["pruned"] if p["stage"] == "contract"]
+assert contract and any("PSC103" in p["rules"] for p in contract), contract
+assert rec["best"]["flag_line"].startswith("--network LeNet"), rec["best"]
+print("autotune smoke: %d ranked, %d pruned (%s), best %s"
+      % (rec["n_candidates"], rec["n_pruned"], sorted(stages),
+         rec["best"]["name"]))
+PYEOF
+
 run python bench.py
 
 echo "SMOKE OK"

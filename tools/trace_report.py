@@ -295,6 +295,20 @@ def main(argv=None) -> int:
         if missing:
             print(f"missing required phases: {missing}", file=sys.stderr)
             return 1
+        if "spans_dropped" in summary["phases"]:
+            # the tracer's bounded ring evicted spans (obs/trace.py's
+            # spans_dropped meta marker): the timeline is silently
+            # truncated, so a gate that demands complete phases must
+            # not pass it — probe/smoke runs would bank partial
+            # evidence as if it were whole
+            print(
+                "required phases present but the stream carries a "
+                "spans_dropped marker — the span ring overflowed and "
+                "the timeline is incomplete (raise the tracer ring "
+                "size or flush more often)",
+                file=sys.stderr,
+            )
+            return 1
     return 0
 
 
