@@ -163,6 +163,11 @@ def _srv_tag() -> str:
     extra = f"_s{_srv_env('SLOTS')}_r{_srv_rate():g}"
     if os.environ.get("BENCH_SRV_INT8KV") == "1":
         extra += "_q8kv"
+    if os.environ.get("BENCH_SRV_OVERLOAD") == "1":
+        # the overload drill (10x spike + deadlines + admission control)
+        # measures goodput under shedding — a different regime, its own
+        # metric key
+        extra += "_ovl"
     return _dec_shape_tag(extra)
 
 
@@ -241,6 +246,7 @@ def _bench_serve() -> tuple:
         init_transformer,
     )
     from ps_pytorch_tpu.serve import (
+        AdmissionController,
         ServeConfig,
         ServingEngine,
         TrafficConfig,
@@ -267,10 +273,23 @@ def _bench_serve() -> tuple:
         max_prompt_len=t_prompt,
         kv_int8=os.environ.get("BENCH_SRV_INT8KV") == "1",
     )
+    # the overload drill (BENCH_SRV_OVERLOAD=1): a 10x seeded traffic
+    # spike over the whole nominal schedule, per-request deadlines, and
+    # SLO-aware admission — measures GOODPUT under shedding, where the
+    # plain leg measures throughput under headroom
+    overload = os.environ.get("BENCH_SRV_OVERLOAD") == "1"
+    reqs, rate = _srv_env("REQS"), _srv_rate()
+    admission = None
+    if overload:
+        admission = AdmissionController(
+            slo_budget_s=float(os.environ.get("BENCH_SRV_SLO", "1.0")),
+            window_s=0.1,
+        )
     # in-memory tracer (no file): the drained spans become the record's
     # per-phase breakdown
     tracer = Tracer("bench_serve")
-    engine = ServingEngine(cfg, params, serve, tracer=tracer)
+    engine = ServingEngine(cfg, params, serve, tracer=tracer,
+                           admission=admission)
     engine.warmup()
     tracer.drain()  # compile-warmup spans are not the measurement
     try:
@@ -280,14 +299,19 @@ def _bench_serve() -> tuple:
     except Exception:
         hlo_ops = None
     tc = TrafficConfig(
-        n_requests=_srv_env("REQS"),
-        rate_rps=_srv_rate(),
+        n_requests=reqs,
+        rate_rps=rate,
         prompt_len_min=max(1, t_prompt // 2),
         prompt_len_max=t_prompt,
         new_tokens_min=max(1, n_new // 2),
         new_tokens_max=n_new,
         vocab_size=cfg.vocab_size,
         seed=0,
+        spike=(10.0, 0.0, reqs / rate) if overload else None,
+        deadline_s=(
+            float(os.environ.get("BENCH_SRV_DEADLINE", "2.0"))
+            if overload else None
+        ),
     )
     summary = run_open_loop(engine, make_requests(tc))
     return summary, hlo_ops, summarize_spans(tracer.drain())
@@ -992,6 +1016,15 @@ def main() -> None:
                 k: summary[k]
                 for k in (
                     "requests_completed", "new_tokens", "elapsed_s",
+                    # lifecycle accounting + goodput (§7i): under the
+                    # BENCH_SRV_OVERLOAD drill shed/expired are the
+                    # story; in the plain leg they pin zero
+                    "requests_submitted", "requests_shed",
+                    "requests_expired",
+                    "goodput_tokens", "goodput_tokens_per_sec",
+                    # p50/p99 TTFT are over admitted requests that got
+                    # a first token: completions + mid-decode expiries
+                    # (shed and pre-admission expiries never emit one)
                     "p50_token_latency_s", "p99_token_latency_s",
                     "p50_ttft_s", "p99_ttft_s",
                     # TTFT decomposition: queue + prefill == TTFT per

@@ -363,8 +363,8 @@ def _decode_payload(data: bytes, path: str):
         raise
 
 
-def _restore_raw(model_dir: str, step: int):
-    data, _ = _read_payload(model_dir, step)
+def _restore_raw(model_dir: str, step: int, read_attempts: int = 3):
+    data, _ = _read_payload(model_dir, step, read_attempts)
     return _decode_payload(data, checkpoint_path(model_dir, step))
 
 
@@ -528,14 +528,20 @@ def restore_sharded(target, model_dir: str, step: int, mesh, specs):
     return place_on_mesh(load_checkpoint(target, model_dir, step), mesh, specs)
 
 
-def load_checkpoint_raw(model_dir: str, step: int) -> dict:
+def load_checkpoint_raw(model_dir: str, step: int,
+                        read_attempts: int = 3) -> dict:
     """Load step N as raw nested dicts, no target structure required.
 
     This is what lets the evaluator stay ignorant of the trainer's optimizer
     and placement config: it only consumes params/batch_stats/step and never
     needs to reconstruct the opt_state pytree (whose structure varies by
-    --optimizer/--opt-placement)."""
-    return _restore_raw(model_dir, step)
+    --optimizer/--opt-placement).
+
+    ``read_attempts=1`` disables the I/O retry backoff — the serving
+    engine's swap-time re-read (serve/engine._try_swap) wants a fast
+    verdict on the staged file, not a multi-attempt stall inside the
+    request loop; its caller treats OSError as an abort, not a retry."""
+    return _restore_raw(model_dir, step, read_attempts)
 
 
 def available_steps(model_dir: str):

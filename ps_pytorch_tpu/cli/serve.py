@@ -1,5 +1,6 @@
 """Serving entry — continuous-batching decode of a trained LM checkpoint
-under synthetic open-loop traffic, with hot checkpoint rollover.
+under synthetic open-loop traffic, with hot checkpoint rollover and the
+serving resilience layer (ARCHITECTURE §7i).
 
 The serving counterpart of cli/evaluate_lm.py: consumes the same
 scheme-agnostic checkpoints cli/train_lm.py writes (dense LMs), loads
@@ -11,12 +12,22 @@ engine polls the checkpoint directory mid-serve and hot-swaps to newer
 weights under the drain-then-swap rule (in-flight requests finish on the
 weights that started them).
 
-Prints exactly ONE JSON summary line (tokens/sec, p50/p99 per-token
-latency, rollovers) — the same record shape the bench serve leg emits.
+Resilience knobs: ``--deadline`` puts a per-request deadline on every
+arrival (expired requests terminate with an event, never silently),
+``--slo-budget`` arms the admission controller (projected queue wait
+above the budget sheds arrivals at the front door), ``--fault-plan``
+injects the serve-side chaos grammar (slow_decode / rollover_corrupt /
+spike), ``--traffic-spike`` drives the seeded burst mode directly, and
+``--events`` writes the structured request-lifecycle JSONL stream.
+
+Prints exactly ONE JSON summary line (tokens/sec, goodput, p50/p99
+per-token latency, lifecycle counts, rollovers) — the same record shape
+the bench serve leg emits.
 
   JAX_PLATFORMS=cpu XLA_FLAGS=--xla_force_host_platform_device_count=8 \\
       python -m ps_pytorch_tpu.cli.serve --model-dir /tmp/lm \\
-      --requests 32 --rate 50 --poll-interval 0.5
+      --requests 32 --rate 50 --poll-interval 0.5 \\
+      --deadline 2.0 --slo-budget 0.5 --traffic-spike 10,0.5,1.0
 """
 
 from __future__ import annotations
@@ -25,7 +36,13 @@ import argparse
 import json
 
 from ..checkpoint import load_checkpoint_raw, load_latest_valid
-from ..serve import ServeConfig, ServingEngine, TrafficConfig
+from ..resilience import resolve_fault_plan
+from ..serve import (
+    AdmissionController,
+    ServeConfig,
+    ServingEngine,
+    TrafficConfig,
+)
 from ..serve.engine import checkpoint_model
 from ..serve.traffic import make_requests, run_open_loop
 from ..utils import get_logger
@@ -69,6 +86,45 @@ def main(argv=None) -> dict:
     p.add_argument("--poll-interval", type=float, default=0.0,
                    help="poll for newer checkpoints every N seconds and "
                         "hot-roll onto them (0 = serve one step forever)")
+    p.add_argument("--deadline", type=float, default=0.0,
+                   help="per-request deadline in seconds from arrival "
+                        "(0 = none); past-deadline requests terminate as "
+                        "'expired' with a deadline_expired event")
+    p.add_argument("--slo-budget", type=float, default=0.0,
+                   help="arm SLO-aware admission control: shed arrivals "
+                        "whose projected queue wait exceeds this many "
+                        "seconds (0 = admit everything)")
+    p.add_argument("--admit-window", type=float, default=0.25,
+                   help="admission controller window seconds (drain-rate "
+                        "estimation + recovery cadence)")
+    p.add_argument("--shed-max-frac", type=float, default=0.9,
+                   help="bounded shed rate: at most this fraction of a "
+                        "window's arrivals is shed")
+    p.add_argument("--recover-windows", type=int, default=2,
+                   help="consecutive clean windows before shedding stops "
+                        "(hysteresis)")
+    p.add_argument("--recover-frac", type=float, default=0.5,
+                   help="a window is clean when projected wait <= this "
+                        "fraction of the SLO budget")
+    p.add_argument("--drain-timeout", type=float, default=0.0,
+                   help="drain watchdog: give up on a staged rollover "
+                        "that pauses admissions longer than N seconds "
+                        "(0 = wait forever)")
+    p.add_argument("--fault-plan", type=str, default=None,
+                   help="serve-side chaos JSON (resilience/faults.py): "
+                        "slow_decode ticks, rollover_corrupt steps, "
+                        "spike [mult,start,dur]; or @path; env "
+                        "PS_TPU_FAULTS")
+    p.add_argument("--traffic-spike", type=str, default=None,
+                   metavar="MULT,START,LEN",
+                   help="seeded square-wave burst: arrivals in "
+                        "[START, START+LEN) seconds come at MULT x "
+                        "--rate (overrides the fault plan's spike)")
+    p.add_argument("--events", type=str, default=None, metavar="FILE",
+                   help="write the structured request-lifecycle event "
+                        "stream (request_done/request_shed/"
+                        "deadline_expired/rollover_abort/admission_adapt)"
+                        " as JSONL here")
     p.add_argument("--no-warmup", action="store_true",
                    help="skip the pre-traffic compile warmup (latency "
                         "percentiles then include XLA compilation)")
@@ -135,9 +191,42 @@ def main(argv=None) -> dict:
                 "num_workers": args.num_workers or 1,
             },
         )
+    faults = resolve_fault_plan(args.fault_plan)
+    spike = None
+    if args.traffic_spike:
+        parts = args.traffic_spike.split(",")
+        if len(parts) != 3:
+            raise SystemExit(
+                f"--traffic-spike wants MULT,START,LEN, got "
+                f"{args.traffic_spike!r}"
+            )
+        spike = tuple(float(x) for x in parts)
+    elif faults is not None and faults.spike is not None:
+        spike = faults.spike
+    event_sink = None
+    if args.events:
+        # the metrics choke point (validates against obs/schema.py and
+        # stamps t_wall); the stream opens with its own run_header
+        from ..obs.schema import run_header
+        from ..trainer import append_metrics_line
+
+        event_sink = lambda rec: append_metrics_line(args.events, rec)
+        event_sink(run_header("serve"))
+    admission = None
+    if args.slo_budget > 0:
+        admission = AdmissionController(
+            slo_budget_s=args.slo_budget,
+            window_s=args.admit_window,
+            shed_max_frac=args.shed_max_frac,
+            recover_frac=args.recover_frac,
+            recover_windows=args.recover_windows,
+            event_sink=event_sink,
+        )
     engine = ServingEngine(
         cfg, params, serve_cfg, mesh=mesh,
         model_dir=args.model_dir, step=step, tracer=tracer,
+        admission=admission, faults=faults, event_sink=event_sink,
+        drain_timeout_s=args.drain_timeout or None,
     )
     logger.info(
         "serving step %d: %d slots x %d positions%s%s",
@@ -166,6 +255,8 @@ def main(argv=None) -> dict:
         new_tokens_max=args.new_max,
         vocab_size=cfg.vocab_size,
         seed=args.seed,
+        spike=spike,
+        deadline_s=args.deadline or None,
     )
     requests = make_requests(
         tc, prompt_source=lambda rng, ln: corpus[next(rows), :ln]

@@ -133,6 +133,45 @@ EVENT_KINDS: Dict[str, EventSpec] = {
         doc="one traced host-side phase: t/dur are seconds on the "
             "stream header's monotonic clock",
     ),
+    # ---- serving request lifecycle (ARCHITECTURE §7i): every submitted
+    # request terminates in EXACTLY one of request_done | request_shed |
+    # deadline_expired — the zero-silent-drops contract the chaos drill
+    # asserts by partitioning rids over these three kinds
+    "request_done": EventSpec(
+        required=("rid", "new_tokens", "weights_step"),
+        int_fields=("rid", "new_tokens", "weights_step"),
+        doc="one request completed (its new-token budget reached); "
+            "met_deadline rides along when the request carried one",
+    ),
+    "request_shed": EventSpec(
+        required=("rid", "projected_wait_s", "queue_depth", "slo_budget_s"),
+        int_fields=("rid", "queue_depth"),
+        doc="admission controller refused the arrival at submit time: "
+            "projected queue wait exceeded the SLO budget (the evidence "
+            "rides in the record)",
+    ),
+    "deadline_expired": EventSpec(
+        required=("rid", "where", "deadline_s"),
+        int_fields=("rid", "tokens_done"),
+        doc="request deadline passed before completion; 'where' is "
+            "submit (dead on arrival) | queue (expired before "
+            "admission) | decode (evicted mid-decode, partial tokens)",
+    ),
+    "rollover_abort": EventSpec(
+        required=("from_step", "staged_step", "reason"),
+        int_fields=("from_step", "staged_step"),
+        doc="a staged rollover was abandoned (corrupt/unreadable staged "
+            "checkpoint at swap time, or the drain watchdog expired); "
+            "service continues on from_step",
+    ),
+    "admission_adapt": EventSpec(
+        required=("state", "projected_wait_s", "queue_depth",
+                  "window_submits", "window_sheds"),
+        int_fields=("queue_depth", "window_submits", "window_sheds",
+                    "windows"),
+        doc="admission controller state change (admitting <-> shedding) "
+            "with the window evidence that drove it",
+    ),
 }
 
 

@@ -19,11 +19,28 @@ randomness. Injectable faults and the defense each one proves:
                          -> graceful-stop consensus, final checkpoint,
                          clean --resume
 
+Serve-side faults (keyed by serve-loop TICK or checkpoint step — the
+serving process has no training step counter; tick numbering starts
+after the engine's compile warmup so plans target served traffic):
+
+  slow_decode            host stall inside the serve tick (tick list +
+  (slow_decode_s)        stall seconds) -> queue depth grows, driving
+                         the admission controller into shedding
+  rollover_corrupt       the checkpoint file is truncated on disk the
+                         moment the engine STAGES it for rollover ->
+                         the swap-time re-read must discover the damage
+                         and abort onto the old weights
+  spike                  [rate_mult, start_s, dur_s]: traffic burst
+                         multiplier over a time range, consumed by the
+                         traffic generator (serve/traffic.py square-
+                         wave rate modulation) -> reproducible overload
+
 The plan comes from ``--fault-plan`` (a JSON object or ``@path`` to one)
 or the ``PS_TPU_FAULTS`` env var, so subprocess tests and tools/smoke.sh
 drive it without touching code. Gradient faults are baked into the
 jitted step as constants (parallel/ps.py); host faults hook the trainer
-loop and the checkpoint writer.
+loop, the checkpoint writer, and the serving engine's tick/rollover
+paths.
 """
 
 from __future__ import annotations
@@ -41,7 +58,18 @@ FAULTS_ENV = "PS_TPU_FAULTS"
 _KNOWN_KEYS = {
     "nan_grads", "inf_grads", "slow_steps", "slow_s",
     "ckpt_write_fail", "ckpt_corrupt", "sigterm",
+    "slow_decode", "slow_decode_s", "rollover_corrupt", "spike",
 }
+
+
+def _truncate_half(path: str) -> None:
+    """Shear a file to half its size in place — the shared corruption
+    primitive behind both checkpoint-corruption hooks (train-side
+    ckpt_corrupt and serve-side rollover_corrupt must damage files the
+    same way, or the two chaos suites drift apart)."""
+    size = os.path.getsize(path)
+    with open(path, "r+b") as f:
+        f.truncate(max(size // 2, 1))
 
 
 def _steps(raw, key) -> Tuple[int, ...]:
@@ -69,6 +97,11 @@ class FaultPlan:
     ckpt_write_fail: Tuple[int, ...] = ()
     ckpt_corrupt: Tuple[int, ...] = ()
     sigterm: Optional[int] = None
+    # serve side: ticks / checkpoint steps / traffic modulation
+    slow_decode: Tuple[int, ...] = ()
+    slow_decode_s: float = 0.05
+    rollover_corrupt: Tuple[int, ...] = ()
+    spike: Optional[Tuple[float, float, float]] = None
 
     def __post_init__(self):
         self._sigterm_fired = False
@@ -107,6 +140,33 @@ class FaultPlan:
             raise ValueError(
                 f"fault plan 'slow_s' must be >= 0, got {slow_s}"
             )
+        slow_decode_s = float(raw.get("slow_decode_s", cls.slow_decode_s))
+        if slow_decode_s < 0:
+            raise ValueError(
+                f"fault plan 'slow_decode_s' must be >= 0, got "
+                f"{slow_decode_s}"
+            )
+        spike = raw.get("spike")
+        if spike is not None:
+            if (
+                not isinstance(spike, (list, tuple))
+                or len(spike) != 3
+                or any(
+                    isinstance(x, bool) or not isinstance(x, (int, float))
+                    for x in spike
+                )
+            ):
+                raise ValueError(
+                    f"fault plan 'spike' must be [rate_mult, start_s, "
+                    f"dur_s] (three numbers), got {spike!r}"
+                )
+            mult, start_s, dur_s = (float(x) for x in spike)
+            if mult <= 0 or start_s < 0 or dur_s <= 0:
+                raise ValueError(
+                    f"fault plan 'spike' needs rate_mult > 0, start_s >= "
+                    f"0, dur_s > 0, got {spike!r}"
+                )
+            spike = (mult, start_s, dur_s)
         return cls(
             nan_grads=_steps(raw.get("nan_grads"), "nan_grads"),
             inf_grads=_steps(raw.get("inf_grads"), "inf_grads"),
@@ -117,6 +177,11 @@ class FaultPlan:
             ckpt_corrupt=_steps(raw.get("ckpt_corrupt"), "ckpt_corrupt"),
             sigterm=(None if raw.get("sigterm") is None
                      else int(raw["sigterm"])),
+            slow_decode=_steps(raw.get("slow_decode"), "slow_decode"),
+            slow_decode_s=slow_decode_s,
+            rollover_corrupt=_steps(raw.get("rollover_corrupt"),
+                                    "rollover_corrupt"),
+            spike=spike,
         )
 
     # --------------------------------------------------------- host hooks
@@ -144,9 +209,23 @@ class FaultPlan:
         """Truncate the just-written checkpoint to half its size —
         simulated on-disk corruption the CRC trailer must catch."""
         if step in self.ckpt_corrupt:
-            size = os.path.getsize(path)
-            with open(path, "r+b") as f:
-                f.truncate(max(size // 2, 1))
+            _truncate_half(path)
+
+    # -------------------------------------------------------- serve hooks
+    def maybe_slow_decode(self, tick: int, sleep=time.sleep) -> None:
+        """Stall the host inside a serve tick (per-tick injected latency:
+        queue growth drives the admission controller). ``sleep`` is
+        injectable so virtual-clock tests advance their clock instead of
+        real-sleeping."""
+        if tick in self.slow_decode:
+            sleep(self.slow_decode_s)
+
+    def maybe_corrupt_staged(self, path: str, step: int) -> None:
+        """Truncate a checkpoint the serving engine just STAGED for
+        rollover — corruption landing between stage and swap, which the
+        swap-time re-read must discover (rollover_abort, not a crash)."""
+        if step in self.rollover_corrupt:
+            _truncate_half(path)
 
 
 def resolve_fault_plan(spec: Optional[str]) -> Optional[FaultPlan]:

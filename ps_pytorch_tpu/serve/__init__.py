@@ -1,15 +1,21 @@
 """Serving subsystem: continuous-batching decode on the mesh with hot
-checkpoint rollover (ARCHITECTURE §7e).
+checkpoint rollover and SLO-aware resilience (ARCHITECTURE §7e, §7i).
 
 - ``engine``: the slot-pool decode engine (one compiled prefill + one
-  compiled decode step, FlatVector weights, drain-then-swap rollover);
-- ``scheduler``: host-side admit/evict slot bookkeeping;
+  compiled decode step, FlatVector weights, drain-then-swap rollover
+  hardened with swap-time re-reads and a drain watchdog);
+- ``scheduler``: host-side admit/evict/expire slot bookkeeping with
+  per-request deadlines;
+- ``admission``: SLO-aware admission control (windowed projected-wait
+  load shedding, hysteretic recovery);
 - ``kv``: the pooled KV cache (compute-dtype or int8 block-scale);
-- ``traffic``: seeded open-loop traffic + the latency summary.
+- ``traffic``: seeded open-loop traffic (Poisson or square-wave burst)
+  + the latency/goodput summary.
 
 Entry point: ``python -m ps_pytorch_tpu.cli.serve``.
 """
 
+from .admission import AdmissionController
 from .engine import (
     ServeConfig,
     ServingEngine,
@@ -17,11 +23,13 @@ from .engine import (
     make_prefill_step,
 )
 from .kv import init_kv_pool
-from .scheduler import Completion, Request, SlotScheduler
+from .scheduler import Completion, Expired, Request, SlotScheduler
 from .traffic import TrafficConfig, make_requests, run_open_loop, summarize
 
 __all__ = [
+    "AdmissionController",
     "Completion",
+    "Expired",
     "Request",
     "ServeConfig",
     "ServingEngine",

@@ -32,6 +32,24 @@ admission pauses, in-flight sequences FINISH ON THE WEIGHTS THAT
 STARTED THEM, then the buffer swaps and admission resumes. A completion
 therefore always carries exactly one ``weights_step``, never a mix.
 
+Rollover is HARDENED against a staged checkpoint going bad during the
+drain (ARCHITECTURE §7i): staging records only the step number (the
+poll validated the bytes it read, then discards them), and the swap
+re-reads the file from disk. A corrupt or unreadable re-read ABORTS
+the swap — one ``rollover_abort`` event, admissions resume on the OLD
+weights token-exact (the flat buffer was never touched), nothing is
+quarantined (the serving process never writes the training
+directory), and the next poll retries whatever is then newest. A
+``drain_timeout_s`` watchdog bounds how long a drain may pause
+admissions before the engine gives up on the staged step entirely.
+
+Request lifecycle contract (§7i): every submitted request terminates
+in EXACTLY one of completed | shed | expired, each with a structured
+JSONL event through ``event_sink`` — ``request_done``,
+``request_shed`` (the AdmissionController refused the arrival), or
+``deadline_expired`` (at submit, in queue, or evicted mid-decode).
+``outcomes`` is the ledger the chaos drill audits for silent drops.
+
 On a mesh the pool shards over the slot axis (parallel/mesh.
 pool_sharding) with weights replicated: the decode step is
 embarrassingly slot-parallel — ZERO collectives, a property the
@@ -42,13 +60,20 @@ from __future__ import annotations
 
 import dataclasses
 import time
-from typing import Any, Dict, List, Optional, Sequence, Tuple
+from collections import deque
+from typing import Any, Deque, Dict, List, Optional, Sequence, Tuple
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from ..checkpoint import listify_raw, load_checkpoint_raw, load_latest_valid
+from ..checkpoint import (
+    CheckpointCorruptError,
+    checkpoint_path,
+    listify_raw,
+    load_checkpoint_raw,
+    load_latest_valid,
+)
 from ..models.transformer import (
     TransformerConfig,
     _rms_norm,
@@ -66,7 +91,7 @@ from ..obs import NULL_TRACER
 from ..parallel.mesh import pool_sharding, replicated_sharding
 from ..utils import get_logger
 from .kv import attend_pool, init_kv_pool, write_slot, write_token
-from .scheduler import Completion, Request, SlotScheduler
+from .scheduler import Completion, Expired, Request, SlotScheduler
 
 logger = get_logger()
 
@@ -170,6 +195,11 @@ class ServingEngine:
         step: Optional[int] = None,
         clock=None,
         tracer=None,
+        admission=None,
+        faults=None,
+        event_sink=None,
+        drain_timeout_s: Optional[float] = None,
+        sleep=None,
     ):
         if not cfg.causal:
             raise ValueError("serving decode is autoregressive: cfg.causal")
@@ -199,6 +229,22 @@ class ServingEngine:
         # Spans run on the tracer's REAL clock, independent of the
         # latency clock above (which tests inject/virtualize).
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        # SLO-aware admission (serve/admission.AdmissionController): when
+        # set, every submit is offered to the controller first; sheds are
+        # evented refusals, never silent drops
+        self.admission = admission
+        # serve-side FaultPlan (resilience/faults.py): slow_decode ticks
+        # and rollover_corrupt staging hooks
+        self.faults = faults
+        # structured lifecycle events (request_done / request_shed /
+        # deadline_expired / rollover_abort) — obs/schema.py kinds
+        self._event_sink = event_sink
+        # drain watchdog: how long a staged rollover may pause admissions
+        # before the engine gives up on the staged step (None = forever)
+        self.drain_timeout_s = drain_timeout_s
+        # injectable stall primitive for fault hooks: virtual-clock tests
+        # advance their clock here instead of real-sleeping
+        self._sleep = sleep if sleep is not None else time.sleep
         self.scheduler = SlotScheduler(
             serve.slots, serve.max_len, serve.max_prompt_len
         )
@@ -236,13 +282,36 @@ class ServingEngine:
         # in — steady-state ticks pay zero host->device transfers
         self._dev: Optional[Tuple[jax.Array, jax.Array, jax.Array]] = None
         self._dirty = True
-        self._pending: Optional[Tuple[int, np.ndarray]] = None
+        # a staged rollover is the STEP NUMBER only: the swap re-reads
+        # the file from disk so damage landing between stage and swap is
+        # discovered (and aborted) instead of served
+        self._pending: Optional[int] = None
         self.rollovers: List[Dict[str, Any]] = []
+        self.rollover_aborts: List[Dict[str, Any]] = []
+        # the lifecycle ledger: rid -> "completed" | "shed" | "expired".
+        # Every submit lands exactly one entry; the chaos drill audits it
+        # against the event stream for silent drops. The per-request
+        # records are BOUNDED (a long-lived server must not grow its
+        # audit without limit — same stance as the tracer ring); the
+        # totals live in outcome_counts and never saturate.
+        self._ledger_cap = 65536
+        self.outcomes: Dict[int, str] = {}
+        self.outcome_counts: Dict[str, int] = {
+            "completed": 0, "shed": 0, "expired": 0,
+        }
+        self.shed: Deque[Dict[str, Any]] = deque(maxlen=self._ledger_cap)
+        self.expired: Deque[Expired] = deque(maxlen=self._ledger_cap)
+        # a step the drain watchdog gave up on: never re-staged (only a
+        # strictly newer checkpoint supersedes it)
+        self._abandoned_step: Optional[int] = None
         self._tick_no = 0
         # per-slot admission instant on the TRACER clock (request
         # lifecycle spans) and the open drain's start, if any
         self._admit_tr_t: Dict[int, float] = {}
         self._drain_tr_t0: Optional[float] = None
+        # the drain's start on the LATENCY clock (tests virtualize it) —
+        # the watchdog's timebase, distinct from the tracer clock above
+        self._drain_clk_t0: Optional[float] = None
 
     # ------------------------------------------------------- construction
     @classmethod
@@ -254,9 +323,12 @@ class ServingEngine:
         mesh=None,
         compute_dtype=None,
         tracer=None,
+        **engine_kw,
     ) -> "ServingEngine":
         """Load a cli/train_lm checkpoint (dense LMs; the evaluator's
-        scheme-agnostic raw layout) into a serving engine."""
+        scheme-agnostic raw layout) into a serving engine.
+        ``engine_kw`` passes through to the constructor (admission,
+        faults, event_sink, drain_timeout_s, clock, sleep)."""
         if step is None:
             found = load_latest_valid(model_dir)
             if found is None:
@@ -266,7 +338,7 @@ class ServingEngine:
             raw = load_checkpoint_raw(model_dir, step)
         cfg, params = checkpoint_model(raw, compute_dtype)
         return cls(cfg, params, serve, mesh=mesh, model_dir=model_dir,
-                   step=step, tracer=tracer)
+                   step=step, tracer=tracer, **engine_kw)
 
     def _place_flat(self, flat: np.ndarray) -> jax.Array:
         if self.mesh is not None:
@@ -276,13 +348,19 @@ class ServingEngine:
     # ---------------------------------------------------------- rollover
     def poll_rollover(self) -> Optional[int]:
         """Stage the newest valid checkpoint newer than the serving step
-        (single-read validate+load). Returns the staged step, or None.
-        The swap itself waits for the drain — see tick()."""
+        (single-read validate). Returns the staged step, or None. Only
+        the STEP is staged — the swap re-reads the file after the drain,
+        so corruption landing in between is discovered, not served. The
+        swap itself waits for the drain — see tick()."""
         if self.model_dir is None:
             return None
         # while a rollover is already staged, only a STRICTLY newer step
-        # re-stages — repeated polls during a drain stay one cheap listdir
-        after = self._pending[0] if self._pending is not None else self.step
+        # re-stages — repeated polls during a drain stay one cheap
+        # listdir; a step the drain watchdog abandoned is never retried
+        after = max(
+            x for x in (self._pending, self._abandoned_step, self.step)
+            if x is not None
+        )
         found = load_latest_valid(self.model_dir, after_step=after)
         if found is None:
             return None
@@ -297,28 +375,63 @@ class ServingEngine:
             )
         if self._drain_tr_t0 is None:
             self._drain_tr_t0 = self.tracer.now()
-        self._pending = (
-            new_step, _flat_params(self._layout, self._plan, params)
-        )
+        if self._drain_clk_t0 is None:
+            self._drain_clk_t0 = self.clock()
+        self._pending = new_step
+        if self.faults is not None:
+            # chaos hook: damage the staged file AFTER validation — the
+            # swap-time re-read must catch it (rollover_abort)
+            self.faults.maybe_corrupt_staged(
+                checkpoint_path(self.model_dir, new_step), new_step
+            )
         logger.info(
             "rollover staged: step %s -> %d (draining %d in-flight)",
             self.step, new_step, self.scheduler.n_inflight,
         )
         return new_step
 
-    def _swap_pending(self, now_s: float) -> None:
-        new_step, flat = self._pending
-        self._pending = None
+    def _close_drain_span(self, to_step: int, outcome: str) -> None:
         if self._drain_tr_t0 is not None:
-            # the drain interval spans ticks: staged in one poll, swapped
-            # when the last in-flight request finished — record it as one
-            # explicit span so the timeline shows WHY admission paused
+            # the drain interval spans ticks: staged in one poll, ended
+            # (swap or abort) ticks later — record it as one explicit
+            # span so the timeline shows WHY admission paused
             self.tracer.add(
                 "rollover_drain", self._drain_tr_t0,
                 self.tracer.now() - self._drain_tr_t0, cat="serve",
-                from_step=self.step, to_step=new_step,
+                from_step=self.step, to_step=to_step, outcome=outcome,
             )
             self._drain_tr_t0 = None
+        self._drain_clk_t0 = None
+
+    def _try_swap(self, now_s: float) -> None:
+        """Drain complete: re-read the staged checkpoint and swap the
+        flat buffer — or abort onto the old weights if the bytes on disk
+        went bad since staging."""
+        new_step = self._pending
+        try:
+            # read_attempts=1: an unreadable staged file is an abort
+            # verdict, not something to retry-backoff INSIDE the request
+            # loop — the next poll is the retry
+            raw = load_checkpoint_raw(self.model_dir, new_step,
+                                      read_attempts=1)
+            _, params = checkpoint_model(raw, self.cfg.compute_dtype)
+            if tree_layout(params).shapes != self._layout.shapes:
+                raise ValueError(
+                    f"staged checkpoint step {new_step} changed param "
+                    f"geometry between stage and swap"
+                )
+            flat = _flat_params(self._layout, self._plan, params)
+        except (CheckpointCorruptError, OSError, ValueError) as e:
+            # the staged bytes are gone/bad: abort the swap, keep serving
+            # the OLD weights (the flat buffer was never touched — token-
+            # exact by construction), retry whatever the next poll finds.
+            # Nothing is quarantined: the serving process never writes
+            # the training directory.
+            self._abort_rollover(now_s, reason="corrupt_staged",
+                                 error=str(e))
+            return
+        self._pending = None
+        self._close_drain_span(new_step, outcome="swap")
         with self.tracer.span(
             "rollover_swap", cat="serve",
             from_step=self.step, to_step=new_step,
@@ -334,26 +447,151 @@ class ServingEngine:
         logger.info("rollover complete: now serving step %d", new_step)
         self.step = new_step
 
+    def _abort_rollover(self, now_s: float, reason: str,
+                        error: str = "") -> None:
+        staged = self._pending
+        self._pending = None
+        self._close_drain_span(staged, outcome="abort")
+        if reason == "drain_timeout":
+            # the watchdog gave up on this step: only a strictly newer
+            # checkpoint may stage again (a corrupt abort retries — the
+            # next poll re-validates the directory from scratch)
+            self._abandoned_step = staged
+        rec = {
+            "kind": "rollover_abort",
+            "from_step": self.step,
+            "staged_step": staged,
+            "reason": reason,
+            "error": error,
+            "at_s": round(now_s, 6),
+        }
+        self.rollover_aborts.append(dict(rec))
+        self._emit(rec)
+        self.tracer.instant(
+            "rollover_abort", cat="serve", from_step=self.step,
+            staged_step=staged, reason=reason,
+        )
+        logger.warning(
+            "rollover abort (%s): staying on step %s, staged step %s "
+            "dropped%s",
+            reason, self.step, staged, f" ({error})" if error else "",
+        )
+
     @property
     def draining(self) -> bool:
         return self._pending is not None
 
     # ------------------------------------------------------------ intake
+    def _emit(self, record: Dict[str, Any]) -> None:
+        if self._event_sink is not None:
+            self._event_sink(record)
+
+    def _record_outcome(self, rid: int, outcome: str) -> None:
+        if rid >= 0:  # warmup probes (negative rids) are not traffic
+            self.outcome_counts[outcome] += 1
+        self.outcomes[rid] = outcome
+        while len(self.outcomes) > self._ledger_cap:
+            self.outcomes.pop(next(iter(self.outcomes)))
+
+    def _record_expired(self, exp: Expired) -> None:
+        self._record_outcome(exp.rid, "expired")
+        self.expired.append(exp)
+        self._emit({
+            "kind": "deadline_expired",
+            "rid": exp.rid,
+            "where": exp.where,
+            "deadline_s": round(exp.deadline_s, 6),
+            "expired_s": round(exp.expired_s, 6),
+            "tokens_done": len(exp.tokens),
+        })
+
     def submit(self, request: Request) -> None:
+        """Front door: a request terminates right here when its deadline
+        already passed (expired) or the admission controller refuses it
+        (shed) — both evented, neither ever queued. Everything else goes
+        to the scheduler's FIFO."""
+        now_s = self.clock()
+        if request.deadline_s is not None and request.deadline_s <= now_s:
+            self._record_expired(Expired(
+                rid=request.rid, where="submit",
+                deadline_s=float(request.deadline_s), expired_s=now_s,
+            ))
+            return
+        if self.admission is not None:
+            shed, projected = self.admission.offered(
+                now_s, self.scheduler.n_queued
+            )
+            if shed:
+                rec = {
+                    "kind": "request_shed",
+                    "rid": request.rid,
+                    "projected_wait_s": round(projected, 6),
+                    "queue_depth": self.scheduler.n_queued,
+                    "slo_budget_s": self.admission.slo_budget_s,
+                    "at_s": round(now_s, 6),
+                }
+                self._record_outcome(request.rid, "shed")
+                self.shed.append(dict(rec))
+                self._emit(rec)
+                return
         self.scheduler.submit(request)
 
     # -------------------------------------------------------------- loop
+    def _expire_deadlines(self, now_s: float) -> None:
+        """Terminate queued and in-flight requests whose deadline passed:
+        queued ones never admit; in-flight ones are evicted mid-decode
+        (their slot is freed and masked out — the next occupant stays
+        token-exact, same argument as a normal evict)."""
+        for req in self.scheduler.expire_queued(now_s):
+            self._record_expired(Expired(
+                rid=req.rid, where="queue",
+                deadline_s=float(req.deadline_s), expired_s=now_s,
+            ))
+        for slot in list(self.scheduler.active_slots):
+            req = self.scheduler.request_in(slot)
+            if req.deadline_s is not None and req.deadline_s <= now_s:
+                exp = self.scheduler.expire_slot(slot, now_s)
+                self._active[slot] = False
+                self._dirty = True
+                t0 = self._admit_tr_t.pop(slot, None)
+                if t0 is not None:
+                    self.tracer.add(
+                        "request", t0, self.tracer.now() - t0,
+                        cat="request", slot=slot, rid=exp.rid,
+                        outcome="expired", new_tokens=len(exp.tokens),
+                    )
+                self._record_expired(exp)
+
     def tick(self) -> List[Completion]:
-        """One scheduler round: swap-if-drained, admit, one decode step,
-        record/evict. Returns the completions that finished this tick."""
+        """One scheduler round: expire deadlines, swap-if-drained (or
+        abort), admit, one decode step, record/evict. Returns the
+        completions that finished this tick."""
         self._tick_no += 1
         tr = self.tracer
+        if self.faults is not None:
+            # injected per-tick stall (chaos: drives queue growth and
+            # with it the admission controller) — host-side, pre-decode
+            self.faults.maybe_slow_decode(self._tick_no, sleep=self._sleep)
         now_s = self.clock()
-        if self._pending is not None and self.scheduler.n_inflight == 0:
-            self._swap_pending(now_s)
+        self._expire_deadlines(now_s)
+        if self._pending is not None:
+            if self.scheduler.n_inflight == 0:
+                self._try_swap(now_s)
+            elif (
+                self.drain_timeout_s is not None
+                and self._drain_clk_t0 is not None
+                and now_s - self._drain_clk_t0 > self.drain_timeout_s
+            ):
+                # drain watchdog: a drain may not pause admissions
+                # forever — give up on the staged step, resume service
+                self._abort_rollover(now_s, reason="drain_timeout")
+        if self.admission is not None:
+            self.admission.observe_tick(now_s, self.scheduler.n_queued)
         if self._pending is None:
             for slot, req in self.scheduler.admit(now_s):
                 self._admit_slot(slot, req)
+                if self.admission is not None:
+                    self.admission.record_admit(now_s)
         if self.scheduler.n_inflight == 0:
             return []
 
@@ -390,6 +628,16 @@ class ServingEngine:
                     c = self.scheduler.evict(
                         slot, emit_s, weights_step=self.step
                     )
+                    self._record_outcome(c.rid, "completed")
+                    self._emit({
+                        "kind": "request_done",
+                        "rid": c.rid,
+                        "new_tokens": len(c.tokens),
+                        "weights_step": c.weights_step,
+                        "met_deadline": c.met_deadline,
+                        "ttft_s": round(c.latencies_s[0], 6)
+                        if c.latencies_s else None,
+                    })
                     t0 = self._admit_tr_t.pop(slot, None)
                     if t0 is not None:
                         # request lifecycle (admission -> finish on the
@@ -446,13 +694,29 @@ class ServingEngine:
     def warmup(self) -> None:
         """Compile both steps (one throwaway request through prefill +
         decode) so served latency measures the engine, not XLA. The pool
-        slot it dirties is freed and overwritten on first real use."""
+        slot it dirties is freed and overwritten on first real use.
+        Bypasses the front door (admission control and fault ticks must
+        target served traffic, not the compile probe): the scheduler is
+        fed directly, the warmup's rid -1 outcome is dropped, and tick
+        numbering restarts at 0 so ``slow_decode`` plans are warmup-
+        invariant."""
         plen = min(2, self.serve.max_prompt_len)
-        self.submit(Request(
+        self.scheduler.submit(Request(
             rid=-1, prompt=np.zeros((plen,), np.int32), max_new_tokens=1
         ))
-        while not self.scheduler.idle:
-            self.tick()
+        faults, sink, adm = self.faults, self._event_sink, self.admission
+        self.faults = None
+        self._event_sink = None
+        self.admission = None  # compile walltime is not drain evidence
+        try:
+            while not self.scheduler.idle:
+                self.tick()
+        finally:
+            self.faults = faults
+            self._event_sink = sink
+            self.admission = adm
+        self.outcomes.pop(-1, None)
+        self._tick_no = 0
 
     def decode_requests(self, requests: Sequence[Request],
                         poll_every: int = 0) -> List[Completion]:

@@ -13,13 +13,20 @@ decides which request occupies which slot at each tick:
 - ``record_token`` appends one generated token + its latency to the
   slot's in-flight state and reports whether the request just finished
   (its ``max_new_tokens`` reached);
-- ``evict`` frees a finished slot and returns the ``Completion``.
+- ``evict`` frees a finished slot and returns the ``Completion``;
+- ``expire_queued`` / ``expire_slot`` terminate requests whose deadline
+  passed — in the queue before admission, or mid-decode with partial
+  tokens. An expired slot is freed exactly like an evicted one, so the
+  next occupant's decode stays token-exact (the masked-write argument:
+  every position the dead sequence scribbled is overwritten before it
+  is first attended).
 
 Slot lifecycle:  FREE -> (admit) -> ACTIVE -> (record_token x N,
-last one finishing) -> FINISHED -> (evict) -> FREE.  Eviction and
-admission both happen between device steps, so a slot freed at tick t
-is re-usable at tick t+1 with no recompilation — static shapes, the
-masks do the rest (serve/engine.py).
+last one finishing) -> FINISHED -> (evict) -> FREE, with a second exit
+ACTIVE -> (expire_slot) -> FREE when the deadline passes mid-decode.
+Eviction, expiry, and admission all happen between device steps, so a
+slot freed at tick t is re-usable at tick t+1 with no recompilation —
+static shapes, the masks do the rest (serve/engine.py).
 """
 
 from __future__ import annotations
@@ -42,6 +49,11 @@ class Request:
     # legitimate instant). None = closed-loop request with no arrival —
     # TTFT is then measured from admission.
     arrival_s: Optional[float] = None
+    # ABSOLUTE deadline on the same clock as arrival_s (the scheduler
+    # clock). None = no deadline. A request whose deadline passes before
+    # its budget is reached terminates as 'expired' — at submit, in the
+    # queue, or mid-decode — never silently.
+    deadline_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -69,6 +81,32 @@ class Completion:
     queue_s: float = 0.0
     prefill_s: float = 0.0
     decode_s: float = 0.0
+    # the request's absolute deadline, carried through so goodput (tokens
+    # completed WITHIN deadline) is computable from completions alone
+    deadline_s: Optional[float] = None
+
+    @property
+    def met_deadline(self) -> bool:
+        return self.deadline_s is None or self.finished_s <= self.deadline_s
+
+
+@dataclasses.dataclass
+class Expired:
+    """A request whose deadline passed before completion. ``where`` names
+    the lifecycle stage that observed the expiry: ``submit`` (deadline
+    already past on arrival), ``queue`` (expired waiting for a slot), or
+    ``decode`` (evicted mid-decode; ``tokens`` holds the partial
+    output — generated, but never a Completion)."""
+
+    rid: int
+    where: str
+    deadline_s: float
+    expired_s: float
+    tokens: List[int] = dataclasses.field(default_factory=list)
+    # time-to-first-token, when the request got far enough to emit one
+    # (where=decode only) — admitted-request TTFT statistics must count
+    # these, or the worst admitted waits vanish from the percentiles
+    ttft_s: Optional[float] = None
 
 
 @dataclasses.dataclass
@@ -121,6 +159,40 @@ class SlotScheduler:
                 f"{self.max_len}"
             )
         self._queue.append(request)
+
+    # ------------------------------------------------------------- expiry
+    def expire_queued(self, now_s: float) -> List[Request]:
+        """Remove and return queued requests whose deadline has passed
+        (deadline <= now: the deadline instant itself is too late to
+        start). Survivors keep their FIFO order."""
+        expired = [
+            r for r in self._queue
+            if r.deadline_s is not None and r.deadline_s <= now_s
+        ]
+        if expired:
+            dead = {id(r) for r in expired}
+            self._queue = deque(
+                r for r in self._queue if id(r) not in dead
+            )
+        return expired
+
+    def expire_slot(self, slot: int, now_s: float) -> Expired:
+        """Evict an in-flight request mid-decode because its deadline
+        passed; the slot is freed for reuse exactly like a normal evict
+        (the next occupant's prefill+decode overwrite every position the
+        dead sequence wrote before it is first attended — token-exact by
+        the same masked-write argument)."""
+        inf = self._inflight.pop(slot)
+        self._free.append(slot)
+        self._free.sort(reverse=True)
+        return Expired(
+            rid=inf.request.rid,
+            where="decode",
+            deadline_s=float(inf.request.deadline_s),
+            expired_s=now_s,
+            tokens=list(inf.tokens),
+            ttft_s=inf.latencies_s[0] if inf.latencies_s else None,
+        )
 
     # ---------------------------------------------------------- admission
     def admit(self, now_s: float = 0.0) -> List[Tuple[int, Request]]:
@@ -188,6 +260,7 @@ class SlotScheduler:
             queue_s=max(inf.admitted_s - arrival, 0.0),
             prefill_s=max(first - base, 0.0),
             decode_s=max(inf.last_token_s - first, 0.0),
+            deadline_s=inf.request.deadline_s,
         )
 
     # ----------------------------------------------------------- queries

@@ -38,6 +38,15 @@ class TrafficConfig:
     new_tokens_max: int = 32
     vocab_size: int = 256
     seed: int = 0
+    # seeded bursty mode (overload drills): (rate_mult, start_s, dur_s)
+    # square-wave rate modulation — arrivals inside [start, start+dur)
+    # come at rate_rps * rate_mult, outside at rate_rps. None = plain
+    # Poisson (bit-identical to the pre-spike generator: same rng draw
+    # order).
+    spike: Optional[tuple] = None
+    # relative per-request deadline: each request's absolute deadline is
+    # arrival_s + deadline_s on the open-loop clock. None = no deadlines.
+    deadline_s: Optional[float] = None
 
 
 def make_requests(
@@ -58,10 +67,31 @@ def make_requests(
         raise ValueError("need 1 <= prompt_len_min <= prompt_len_max")
     if not 1 <= tc.new_tokens_min <= tc.new_tokens_max:
         raise ValueError("need 1 <= new_tokens_min <= new_tokens_max")
+    if tc.deadline_s is not None and tc.deadline_s <= 0:
+        raise ValueError("deadline_s must be > 0 (None disables)")
     rng = np.random.RandomState(tc.seed)
-    # Poisson process: exponential inter-arrival gaps at rate_rps
-    gaps = rng.exponential(1.0 / tc.rate_rps, size=tc.n_requests)
-    arrivals = np.cumsum(gaps)
+    if tc.spike is None:
+        # Poisson process: exponential inter-arrival gaps at rate_rps
+        gaps = rng.exponential(1.0 / tc.rate_rps, size=tc.n_requests)
+        arrivals = np.cumsum(gaps)
+    else:
+        mult, start_s, dur_s = (float(x) for x in tc.spike)
+        if mult <= 0 or start_s < 0 or dur_s <= 0:
+            raise ValueError(
+                f"spike needs rate_mult > 0, start_s >= 0, dur_s > 0, "
+                f"got {tc.spike!r}"
+            )
+        # square-wave rate modulation: each gap is drawn at the rate in
+        # force when it begins — a seeded two-state renewal process, so
+        # the overload drill replays the identical burst bit-for-bit
+        t = 0.0
+        arrivals = np.empty(tc.n_requests, np.float64)
+        for i in range(tc.n_requests):
+            rate = tc.rate_rps * (
+                mult if start_s <= t < start_s + dur_s else 1.0
+            )
+            t += float(rng.exponential(1.0 / rate))
+            arrivals[i] = t
     out: List[Request] = []
     for rid in range(tc.n_requests):
         plen = int(rng.randint(tc.prompt_len_min, tc.prompt_len_max + 1))
@@ -69,13 +99,18 @@ def make_requests(
             prompt = np.asarray(prompt_source(rng, plen), np.int32)
         else:
             prompt = rng.randint(0, tc.vocab_size, size=plen).astype(np.int32)
+        arrival = float(arrivals[rid])
         out.append(Request(
             rid=rid,
             prompt=prompt,
             max_new_tokens=int(
                 rng.randint(tc.new_tokens_min, tc.new_tokens_max + 1)
             ),
-            arrival_s=float(arrivals[rid]),
+            arrival_s=arrival,
+            deadline_s=(
+                arrival + tc.deadline_s if tc.deadline_s is not None
+                else None
+            ),
         ))
     return out
 
@@ -133,19 +168,42 @@ def run_open_loop(
 
 def summarize(completions: Sequence[Completion], elapsed_s: float,
               engine: Optional[ServingEngine] = None) -> Dict:
-    """Reduce completions to the serving headline record."""
+    """Reduce completions to the serving headline record.
+
+    Alongside raw tokens/sec: GOODPUT (tokens of completions that met
+    their deadline — the number overload actually degrades; without
+    deadlines every completed token is good by definition) and the
+    lifecycle counts (shed/expired from the engine's ledger, so the
+    record accounts for every submitted request, not just the winners).
+    The TTFT percentiles are over ADMITTED requests that emitted a first
+    token: completions AND mid-decode expiries (whose TTFT the scheduler
+    preserves on the Expired record) — dropping the latter would hide
+    exactly the worst admitted waits from the tail under overload. Shed
+    and pre-admission expiries never produce a first token."""
     latencies = np.asarray(
         [lat for c in completions for lat in c.latencies_s], np.float64
     )
     ttft = np.asarray(
-        [c.latencies_s[0] for c in completions if c.latencies_s], np.float64
+        [c.latencies_s[0] for c in completions if c.latencies_s]
+        + (
+            [e.ttft_s for e in engine.expired if e.ttft_s is not None]
+            if engine is not None else []
+        ),
+        np.float64,
     )
     n_tokens = int(sum(len(c.tokens) for c in completions))
+    good_tokens = int(sum(
+        len(c.tokens) for c in completions if c.met_deadline
+    ))
     out = {
         "requests_completed": len(completions),
         "new_tokens": n_tokens,
         "elapsed_s": round(float(elapsed_s), 6),
         "tokens_per_sec": round(n_tokens / elapsed_s, 2) if elapsed_s > 0 else None,
+        "goodput_tokens": good_tokens,
+        "goodput_tokens_per_sec": (
+            round(good_tokens / elapsed_s, 2) if elapsed_s > 0 else None
+        ),
         "p50_token_latency_s": _pct(latencies, 50),
         "p99_token_latency_s": _pct(latencies, 99),
         "p50_ttft_s": _pct(ttft, 50),
@@ -162,6 +220,16 @@ def summarize(completions: Sequence[Completion], elapsed_s: float,
     if engine is not None:
         out["weights_step"] = engine.step
         out["rollovers"] = list(engine.rollovers)
+        out["rollover_aborts"] = list(engine.rollover_aborts)
+        # the lifecycle counters (warmup's negative rids excluded): every
+        # submitted request lands in exactly one bucket — the
+        # zero-silent-drops audit the chaos smoke runs on this record.
+        # Counters, not the bounded per-request ledger: totals must
+        # survive a long-lived server's ledger eviction.
+        counts = engine.outcome_counts
+        out["requests_submitted"] = sum(counts.values())
+        out["requests_shed"] = counts["shed"]
+        out["requests_expired"] = counts["expired"]
     return out
 
 

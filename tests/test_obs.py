@@ -219,6 +219,24 @@ SAMPLE_EVENTS = {
                  "n_points": 7.0, "n_candidates": 5.0, "n_pruned": 2.0,
                  "gate": {"min_modeled_speedup": None,
                           "modeled_speedup": 1.0}},
+    # serving request lifecycle (serve/engine.py emitters, §7i) —
+    # counters float-typed on purpose where JSON round-trips may float
+    "request_done": {"kind": "request_done", "rid": 7, "new_tokens": 12.0,
+                     "weights_step": 20.0, "met_deadline": True,
+                     "ttft_s": 0.01},
+    "request_shed": {"kind": "request_shed", "rid": 8,
+                     "projected_wait_s": 1.25, "queue_depth": 14.0,
+                     "slo_budget_s": 0.5, "at_s": 3.5},
+    "deadline_expired": {"kind": "deadline_expired", "rid": 9,
+                         "where": "decode", "deadline_s": 2.0,
+                         "expired_s": 2.25, "tokens_done": 3.0},
+    "rollover_abort": {"kind": "rollover_abort", "from_step": 10.0,
+                       "staged_step": 20.0, "reason": "corrupt_staged",
+                       "error": "CRC mismatch", "at_s": 4.0},
+    "admission_adapt": {"kind": "admission_adapt", "state": "shedding",
+                        "projected_wait_s": 1.5, "queue_depth": 14.0,
+                        "window_submits": 9.0, "window_sheds": 6.0,
+                        "windows": 3.0, "slo_budget_s": 0.5},
 }
 
 

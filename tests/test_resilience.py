@@ -109,6 +109,44 @@ def test_fault_plan_parse_and_resolution(tmp_path, monkeypatch):
     assert resolve_fault_plan(None) is None
 
 
+def test_fault_plan_serve_grammar_parses_and_validates(tmp_path):
+    """The serve-side chaos keys (ISSUE 12): slow_decode ticks with an
+    injectable sleep, rollover_corrupt staging truncation, and the spike
+    traffic modulation triple — parsed with the same strictness as the
+    train-side plan."""
+    plan = FaultPlan.parse(
+        '{"slow_decode": [3, 1], "slow_decode_s": 0.02,'
+        ' "rollover_corrupt": [20], "spike": [10, 0.5, 1]}'
+    )
+    assert plan.slow_decode == (1, 3)
+    assert plan.slow_decode_s == 0.02
+    assert plan.rollover_corrupt == (20,)
+    assert plan.spike == (10.0, 0.5, 1.0)
+    # the sleep primitive is injectable (virtual-clock chaos tests)
+    stalls = []
+    plan.maybe_slow_decode(3, sleep=stalls.append)
+    plan.maybe_slow_decode(2, sleep=stalls.append)
+    assert stalls == [0.02]
+    # rollover_corrupt truncates only the planned step
+    f = tmp_path / "ckpt"
+    f.write_bytes(b"x" * 100)
+    plan.maybe_corrupt_staged(str(f), 19)
+    assert f.stat().st_size == 100
+    plan.maybe_corrupt_staged(str(f), 20)
+    assert f.stat().st_size == 50
+    # malformed serve keys fail at parse time, not mid-serve
+    with pytest.raises(ValueError, match="spike"):
+        FaultPlan.parse('{"spike": [10, 0.5]}')
+    with pytest.raises(ValueError, match="spike"):
+        FaultPlan.parse('{"spike": [0, 0, 1]}')
+    with pytest.raises(ValueError, match="spike"):
+        FaultPlan.parse('{"spike": [true, 0, 1]}')
+    with pytest.raises(ValueError, match="slow_decode_s"):
+        FaultPlan.parse('{"slow_decode_s": -1}')
+    with pytest.raises(ValueError, match="must be integers"):
+        FaultPlan.parse('{"slow_decode": [1.5]}')
+
+
 # ------------------------------------------------------------ guard (device)
 def test_skipped_step_is_identity(mesh):
     """An injected NaN (step 2) / Inf (step 3) leaves params AND optimizer

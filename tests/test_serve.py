@@ -10,10 +10,15 @@ The load-bearing pins:
 - rollover semantics are drain-then-swap: in-flight sequences FINISH ON
   THE WEIGHTS THAT STARTED THEM (completions carry exactly one
   weights_step), admission pauses while draining, and post-swap requests
-  decode on the new weights.
+  decode on the new weights;
+- the request lifecycle contract (ARCHITECTURE §7i): every submitted
+  request terminates in EXACTLY one of completed | shed | expired, each
+  with a structured event — pinned end-to-end by the chaos drill (10x
+  spike + slow_decode + rollover_corrupt) at the bottom of this file.
 """
 
 import dataclasses
+import os
 
 import numpy as np
 import pytest
@@ -26,7 +31,11 @@ from ps_pytorch_tpu.models.transformer import (
     TransformerConfig,
     init_transformer,
 )
+from ps_pytorch_tpu.obs.schema import validate_event
+from ps_pytorch_tpu.resilience import FaultPlan
 from ps_pytorch_tpu.serve import (
+    AdmissionController,
+    Completion,
     Request,
     ServeConfig,
     ServingEngine,
@@ -34,7 +43,23 @@ from ps_pytorch_tpu.serve import (
     TrafficConfig,
     make_requests,
     run_open_loop,
+    summarize,
 )
+
+
+class VClock:
+    """Injectable virtual clock: ``()`` reads it, ``sleep`` advances it —
+    so injected stalls (FaultPlan.slow_decode) move virtual time the way
+    real stalls move the wall clock."""
+
+    def __init__(self):
+        self.t = 0.0
+
+    def __call__(self):
+        return self.t
+
+    def sleep(self, dt):
+        self.t += dt
 
 CFG = TransformerConfig(vocab_size=29, dim=32, depth=2, heads=4,
                         max_seq_len=64)
@@ -373,3 +398,502 @@ def test_open_loop_summary_records_latency_percentiles():
         assert summary[key] is not None and np.isfinite(summary[key])
     assert summary["p50_token_latency_s"] <= summary["p99_token_latency_s"]
     assert summary["rollovers"] == []
+    # the lifecycle ledger on a calm run: everything submitted completed
+    assert summary["requests_submitted"] == 8
+    assert summary["requests_shed"] == 0
+    assert summary["requests_expired"] == 0
+    assert summary["rollover_aborts"] == []
+    # no deadlines: every completed token is good by definition
+    assert summary["goodput_tokens"] == summary["new_tokens"]
+
+
+def test_traffic_spike_mode_is_seeded_and_bursty():
+    base = TrafficConfig(n_requests=64, rate_rps=10.0, seed=5)
+    sp = dataclasses.replace(base, spike=(20.0, 0.0, 1.0))
+    a, b = make_requests(sp), make_requests(sp)
+    # bit-identical replay: the overload drill is reproducible
+    assert [r.arrival_s for r in a] == [r.arrival_s for r in b]
+    assert all(np.array_equal(x.prompt, y.prompt) for x, y in zip(a, b))
+    # inside the spike window arrivals come at 200 rps — the base rate
+    # would land ~10 in the first second, the burst floods it
+    in_spike = sum(1 for r in a if r.arrival_s < 1.0)
+    assert in_spike > 32, in_spike
+    with pytest.raises(ValueError, match="spike"):
+        make_requests(dataclasses.replace(base, spike=(0.0, 0.0, 1.0)))
+    with pytest.raises(ValueError, match="spike"):
+        make_requests(dataclasses.replace(base, spike=(2.0, -1.0, 1.0)))
+
+
+def test_traffic_deadlines_are_relative_to_arrival():
+    tc = TrafficConfig(n_requests=8, rate_rps=10.0, seed=1, deadline_s=0.5)
+    for r in make_requests(tc):
+        assert r.deadline_s == pytest.approx(r.arrival_s + 0.5)
+    with pytest.raises(ValueError, match="deadline_s"):
+        make_requests(dataclasses.replace(tc, deadline_s=0.0))
+
+
+def test_summary_reports_goodput_and_deadline_misses():
+    met = Completion(rid=0, prompt=np.zeros(2, np.int32), tokens=[1, 2, 3],
+                     latencies_s=[0.1, 0.1, 0.1], finished_s=1.0,
+                     deadline_s=2.0)
+    missed = Completion(rid=1, prompt=np.zeros(2, np.int32), tokens=[4, 5],
+                        latencies_s=[0.1, 0.1], finished_s=3.0,
+                        deadline_s=2.0)
+    assert met.met_deadline and not missed.met_deadline
+    s = summarize([met, missed], elapsed_s=2.0)
+    assert s["new_tokens"] == 5
+    assert s["goodput_tokens"] == 3
+    assert s["goodput_tokens_per_sec"] == pytest.approx(1.5)
+
+
+# ------------------------------------------------- scheduler deadline edges
+
+def test_scheduler_expire_queued_preserves_fifo():
+    s = SlotScheduler(n_slots=1, max_len=32, max_prompt_len=8)
+    deadlines = [None, 1.0, None, 0.5]
+    for r, d in zip(_requests([(4, 4)] * 4), deadlines):
+        s.submit(dataclasses.replace(r, deadline_s=d))
+    expired = s.expire_queued(2.0)
+    assert [r.rid for r in expired] == [1, 3]
+    assert s.n_queued == 2
+    # survivors keep FIFO order: rid 0 admits first
+    admitted = s.admit()
+    assert [(slot, r.rid) for slot, r in admitted] == [(0, 0)]
+    # a deadline exactly at 'now' is too late to start
+    s2 = SlotScheduler(n_slots=1, max_len=32, max_prompt_len=8)
+    s2.submit(dataclasses.replace(_requests([(4, 4)])[0], deadline_s=3.0))
+    assert [r.rid for r in s2.expire_queued(3.0)] == [0]
+
+
+def test_engine_expires_dead_on_arrival_at_submit():
+    events = []
+    vc = VClock()
+    vc.t = 5.0
+    engine = ServingEngine(CFG, _params(), SERVE, clock=vc,
+                           event_sink=events.append)
+    engine.submit(dataclasses.replace(
+        _requests([(4, 4)])[0], deadline_s=1.0
+    ))
+    assert engine.outcomes == {0: "expired"}
+    assert engine.scheduler.idle  # never queued
+    (ev,) = events
+    assert ev["kind"] == "deadline_expired" and ev["where"] == "submit"
+    validate_event(dict(ev))
+
+
+def test_engine_expires_queued_request_before_admission():
+    events = []
+    vc = VClock()
+    serve1 = dataclasses.replace(SERVE, slots=1)
+    engine = ServingEngine(CFG, _params(), serve1, clock=vc,
+                           event_sink=events.append)
+    long_req, short_req = _requests([(3, 20), (3, 4)])
+    engine.submit(long_req)                       # occupies the only slot
+    engine.tick()
+    engine.submit(dataclasses.replace(short_req, deadline_s=0.05))
+    assert engine.scheduler.n_queued == 1
+    vc.t = 0.1                                    # deadline passes in queue
+    engine.tick()
+    assert engine.outcomes[1] == "expired"
+    assert engine.scheduler.n_queued == 0
+    exp = engine.expired[0]
+    assert exp.where == "queue" and exp.tokens == []
+    kinds = [e["kind"] for e in events]
+    assert kinds.count("deadline_expired") == 1
+
+
+def test_slot_reuse_after_mid_decode_expiry_is_token_exact():
+    """THE expiry exactness pin: a request evicted mid-decode by its
+    deadline frees its slot, and the next occupant of that slot decodes
+    exactly the tokens of an independent per-sequence run — the dead
+    sequence's K/V scribbles are masked/overwritten, same argument as a
+    normal evict."""
+    params = _params()
+    events = []
+    vc = VClock()
+    serve1 = dataclasses.replace(SERVE, slots=1)
+    engine = ServingEngine(CFG, params, serve1, clock=vc,
+                           event_sink=events.append)
+    engine.warmup()
+    a = dataclasses.replace(
+        _requests([(5, 20)])[0], deadline_s=0.025
+    )
+    engine.submit(a)
+    for _ in range(3):
+        engine.tick()
+        vc.t += 0.01
+    engine.tick()  # t=0.03 > deadline 0.025: expire mid-decode
+    assert engine.outcomes[0] == "expired"
+    exp = engine.expired[0]
+    assert exp.where == "decode"
+    assert 0 < len(exp.tokens) < 20
+    # the partial output is a prefix of the oracle's greedy decode
+    np.testing.assert_array_equal(
+        np.asarray(exp.tokens), _oracle(params, a)[: len(exp.tokens)]
+    )
+    assert engine.scheduler.n_free == 1
+    # slot reuse: the next occupant is token-exact vs independent decode
+    b = dataclasses.replace(_requests([(7, 8)], seed=3)[0], rid=1)
+    (out,) = engine.decode_requests([b])
+    np.testing.assert_array_equal(
+        np.asarray(out.tokens), _oracle(params, b)
+    )
+    assert engine.outcomes[1] == "completed"
+    ev = [e for e in events if e["kind"] == "deadline_expired"]
+    assert ev and ev[0]["tokens_done"] == len(exp.tokens)
+
+
+# ------------------------------------------------------ admission control
+
+def test_admission_controller_sheds_on_projected_wait():
+    events = []
+    c = AdmissionController(slo_budget_s=1.0, window_s=1.0,
+                            shed_max_frac=1.0, event_sink=events.append)
+    # never shed before the first window of evidence
+    shed, proj = c.offered(0.0, 100)
+    assert not shed and proj == 0.0
+    for t in (0.2, 0.4, 0.6, 0.8):
+        c.record_admit(t)
+    c.observe_tick(1.0, 5)           # window closes: drain rate 4 req/s
+    shed, proj = c.offered(1.1, 10)  # projected 10/4 = 2.5s > 1s budget
+    assert shed and proj == pytest.approx(2.5)
+    assert c.shedding and c.shed_total == 1
+    ev = [e for e in events if e["kind"] == "admission_adapt"]
+    assert ev and ev[-1]["state"] == "shedding"
+    assert ev[-1]["projected_wait_s"] == pytest.approx(2.5)
+    validate_event(dict(ev[-1]))
+    # an empty queue projects zero wait no matter the rate
+    assert c.projected_wait_s(0) == 0.0
+
+
+def test_admission_controller_hysteresis_on_recovery():
+    events = []
+    c = AdmissionController(slo_budget_s=1.0, window_s=1.0,
+                            shed_max_frac=1.0, recover_frac=0.5,
+                            recover_windows=2, event_sink=events.append)
+    c.observe_tick(0.0, 0)
+    c.record_admit(0.5)
+    c.record_admit(0.6)
+    c.observe_tick(1.0, 0)           # drain rate 2 req/s
+    shed, _ = c.offered(1.5, 10)     # projected 5s -> shedding
+    assert shed and c.shedding
+    c.observe_tick(2.5, 0)           # clean close #1: still shedding
+    assert c.shedding
+    c.observe_tick(3.5, 2)           # projected 1.0 > 0.5: streak resets
+    assert c.shedding
+    c.observe_tick(4.5, 0)           # clean close #1 (again)
+    assert c.shedding
+    c.observe_tick(5.5, 0)           # clean close #2 -> admitting
+    assert not c.shedding
+    states = [e["state"] for e in events if e["kind"] == "admission_adapt"]
+    assert states == ["shedding", "admitting"]
+    assert c.adaptations == 2
+
+
+def test_admission_controller_bounded_shed_rate():
+    c = AdmissionController(slo_budget_s=0.1, window_s=100.0,
+                            shed_max_frac=0.5)
+    c.observe_tick(0.0, 0)
+    c.record_admit(1.0)
+    c.observe_tick(100.0, 50)        # drain rate 0.01 req/s: hopeless
+    decisions = [
+        c.offered(100.0 + i * 1e-3, 50)[0] for i in range(10)
+    ]
+    assert c.shedding
+    # at most half of a window's submits shed: strict alternation here
+    assert decisions == [False, True] * 5
+    assert c.shed_total == 5
+
+
+def test_admission_controller_ignores_stale_window_after_lull():
+    """A window left open through a traffic lull closes with
+    lull-inflated elapsed time; using it as drain evidence would
+    collapse the rate estimate and shed the first healthy burst after
+    the lull. Stale windows (elapsed > 2x window) are discarded."""
+    c = AdmissionController(slo_budget_s=1.0, window_s=1.0,
+                            shed_max_frac=1.0)
+    c.observe_tick(0.0, 0)
+    for t in (0.2, 0.4, 0.6, 0.8):
+        c.record_admit(t)
+    c.observe_tick(1.0, 0)           # on-time close: drain rate 4 req/s
+    c.record_admit(1.5)              # one admit, then a 60s lull
+    shed, proj = c.offered(61.0, 4)  # first signal after the lull
+    assert c._drain_rate == pytest.approx(4.0)  # stale window discarded
+    assert proj == pytest.approx(1.0) and not shed
+
+
+def test_admission_controller_validates_config():
+    for bad in (
+        dict(slo_budget_s=0.0),
+        dict(slo_budget_s=1.0, window_s=0.0),
+        dict(slo_budget_s=1.0, shed_max_frac=0.0),
+        dict(slo_budget_s=1.0, shed_max_frac=1.5),
+        dict(slo_budget_s=1.0, recover_frac=1.0),
+        dict(slo_budget_s=1.0, recover_windows=0),
+    ):
+        with pytest.raises(ValueError):
+            AdmissionController(**bad)
+
+
+def test_engine_sheds_at_submit_with_event():
+    events = []
+    vc = VClock()
+    serve1 = dataclasses.replace(SERVE, slots=1)
+    ctrl = AdmissionController(slo_budget_s=0.05, window_s=0.1,
+                               shed_max_frac=1.0,
+                               event_sink=events.append)
+    engine = ServingEngine(CFG, _params(), serve1, clock=vc,
+                           admission=ctrl, event_sink=events.append)
+    reqs = _requests([(3, 12), (3, 12), (3, 4), (3, 4)])
+    engine.submit(reqs[0])           # admitted into the only slot at t=0
+    for _ in range(12):              # the slot stays busy a full window
+        engine.tick()
+        vc.t += 0.01
+    # window closed mid-loop: drain rate ~ 1 admit / 0.1 s = 10 req/s
+    engine.submit(reqs[1])           # empty queue: projected 0, queued
+    engine.submit(reqs[2])           # behind one: projected ~0.1s > budget
+    engine.submit(reqs[3])
+    assert engine.outcomes.get(2) == "shed"
+    assert engine.outcomes.get(3) == "shed"
+    shed_evs = [e for e in events if e["kind"] == "request_shed"]
+    assert len(shed_evs) == 2, [e["kind"] for e in events]
+    for e in shed_evs:
+        validate_event(dict(e))
+        assert e["projected_wait_s"] > 0.05
+        assert engine.outcomes[e["rid"]] == "shed"
+    assert [e["kind"] for e in events].count("admission_adapt") == 1
+
+
+# ------------------------------------------------- rollover hardening
+
+def test_rollover_corrupt_staged_aborts_onto_old_weights(tmp_path):
+    """Rollover-abort rule (ARCHITECTURE §7i): a staged checkpoint that
+    goes bad between stage and swap aborts the swap with a
+    rollover_abort event, service continues on the OLD weights
+    token-exact, nothing is quarantined, and the next poll retries."""
+    from ps_pytorch_tpu.checkpoint import checkpoint_path
+
+    old_params, new_params = _params(seed=0), _params(seed=1)
+    _write_lm_ckpt(tmp_path, 1, old_params)
+    events = []
+    engine = ServingEngine.from_checkpoint(
+        str(tmp_path), SERVE, step=1, event_sink=events.append
+    )
+    r_old = _requests([(5, 12)])[0]
+    engine.submit(r_old)
+    for _ in range(3):
+        engine.tick()
+
+    _write_lm_ckpt(tmp_path, 2, new_params)
+    assert engine.poll_rollover() == 2
+    assert engine.draining and engine.scheduler.n_inflight == 1
+    # damage lands AFTER staging (the poll validated the bytes it read)
+    path2 = checkpoint_path(str(tmp_path), 2)
+    with open(path2, "r+b") as f:
+        f.truncate(max(os.path.getsize(path2) // 2, 1))
+
+    done = {}
+    while not engine.scheduler.idle or engine.draining:
+        for c in engine.tick():
+            done[c.rid] = c
+    # the swap was aborted: still serving step 1, no rollover recorded
+    assert engine.step == 1
+    assert engine.rollovers == []
+    assert len(engine.rollover_aborts) == 1
+    ab = engine.rollover_aborts[0]
+    assert ab["reason"] == "corrupt_staged"
+    assert ab["from_step"] == 1 and ab["staged_step"] == 2
+    (ev,) = [e for e in events if e["kind"] == "rollover_abort"]
+    validate_event(dict(ev))
+    # the in-flight request finished on the weights that started it
+    assert done[0].weights_step == 1
+    np.testing.assert_array_equal(
+        np.asarray(done[0].tokens), _oracle(old_params, r_old)
+    )
+    # nothing quarantined: the damaged file is still there, untouched
+    assert os.path.exists(path2)
+    # next poll retries the directory; the damaged step is skipped
+    assert engine.poll_rollover() is None
+    assert not engine.draining
+    # post-abort service on the old weights stays token-exact
+    r_next = dataclasses.replace(_requests([(6, 7)], seed=2)[0], rid=1)
+    (out,) = engine.decode_requests([r_next])
+    assert out.weights_step == 1
+    np.testing.assert_array_equal(
+        np.asarray(out.tokens), _oracle(old_params, r_next)
+    )
+    # a repaired/newer checkpoint rolls over normally afterwards
+    _write_lm_ckpt(tmp_path, 3, new_params)
+    assert engine.poll_rollover() == 3
+    r_post = dataclasses.replace(_requests([(6, 7)], seed=4)[0], rid=2)
+    (out3,) = engine.decode_requests([r_post])
+    assert engine.step == 3 and out3.weights_step == 3
+    np.testing.assert_array_equal(
+        np.asarray(out3.tokens), _oracle(new_params, r_post)
+    )
+
+
+def test_drain_watchdog_gives_up_on_staged_step(tmp_path):
+    """The serve watchdog bounds how long a drain may pause admissions:
+    past --drain-timeout the engine abandons the staged step (abort
+    event, reason drain_timeout), resumes admissions on the old weights,
+    and never re-stages the abandoned step — only a strictly newer
+    checkpoint supersedes it."""
+    events = []
+    vc = VClock()
+    old_params, new_params = _params(seed=0), _params(seed=1)
+    _write_lm_ckpt(tmp_path, 1, old_params)
+    engine = ServingEngine.from_checkpoint(
+        str(tmp_path), SERVE, step=1, clock=vc,
+        event_sink=events.append, drain_timeout_s=0.05,
+    )
+    engine.submit(_requests([(4, 30)])[0])   # a long-running in-flight
+    engine.tick()
+    _write_lm_ckpt(tmp_path, 2, new_params)
+    assert engine.poll_rollover() == 2
+    assert engine.draining
+    queued = dataclasses.replace(_requests([(4, 4)], seed=1)[0], rid=1)
+    engine.submit(queued)                    # stuck behind the drain
+    for _ in range(4):                       # drain exceeds the timeout
+        vc.t += 0.02
+        engine.tick()
+    assert not engine.draining               # watchdog gave up
+    ab = [a for a in engine.rollover_aborts if a["reason"] == "drain_timeout"]
+    assert len(ab) == 1 and ab[0]["staged_step"] == 2
+    assert engine.step == 1
+    # admissions resumed: the queued request got a slot
+    assert engine.scheduler.n_queued == 0
+    assert engine.scheduler.n_inflight == 2
+    # the abandoned step is never re-staged...
+    assert engine.poll_rollover() is None
+    # ...but a strictly newer checkpoint is
+    _write_lm_ckpt(tmp_path, 3, new_params)
+    assert engine.poll_rollover() == 3
+
+
+# ----------------------------------------------------- THE chaos drill
+
+def test_serving_chaos_drill_spike_sheds_and_rollover_abort(tmp_path):
+    """The acceptance pin (ISSUE 12): a 10x traffic spike with
+    slow_decode stalls active, per-request deadlines, SLO-aware
+    admission, and a rollover_corrupt fault mid-drain. Asserts
+
+    - every submitted request terminates as EXACTLY one of
+      completed/shed/expired, each with a matching structured event
+      (zero silent drops);
+    - admitted-request p99 TTFT stays within the declared SLO budget
+      while raw arrivals exceed capacity;
+    - the corrupt staged checkpoint yields a rollover_abort and
+      service continues token-exact on the old weights."""
+    old_params, new_params = _params(seed=0), _params(seed=1)
+    _write_lm_ckpt(tmp_path, 1, old_params)
+
+    SLO_BUDGET_S = 0.3
+    DEADLINE_S = 0.2
+    TICK_S = 0.01
+    events = []
+    vc = VClock()
+    ctrl = AdmissionController(
+        slo_budget_s=SLO_BUDGET_S, window_s=0.1, shed_max_frac=0.9,
+        event_sink=events.append,
+    )
+    plan = FaultPlan.parse(
+        '{"slow_decode": [5, 6, 7, 8], "slow_decode_s": 0.02,'
+        ' "rollover_corrupt": [2]}'
+    )
+    serve2 = dataclasses.replace(SERVE, slots=2)
+    engine = ServingEngine.from_checkpoint(
+        str(tmp_path), serve2, step=1, clock=vc, sleep=vc.sleep,
+        admission=ctrl, faults=plan, event_sink=events.append,
+    )
+    engine.warmup()
+
+    # 10x spike over the whole schedule: ~36 requests in ~0.12s against
+    # a capacity of ~40 req/s (2 slots x ~5 tokens x 100 ticks/s)
+    tc = TrafficConfig(
+        n_requests=36, rate_rps=30.0, prompt_len_min=2, prompt_len_max=8,
+        new_tokens_min=4, new_tokens_max=6, vocab_size=CFG.vocab_size,
+        seed=1, spike=(10.0, 0.0, 2.0), deadline_s=DEADLINE_S,
+    )
+    pending = sorted(make_requests(tc), key=lambda r: r.arrival_s)
+    submitted = {r.rid for r in pending}
+    completions = []
+    staged = False
+    ticks = 0
+    while pending or not engine.scheduler.idle or engine.draining:
+        t = vc.t
+        while pending and pending[0].arrival_s <= t:
+            engine.submit(pending.pop(0))
+        if not staged and ticks == 4:
+            # mid-overload rollover attempt (before the slow_decode
+            # storm, while slots are busy so the drain is real); the
+            # fault truncates the staged file the moment it is staged
+            _write_lm_ckpt(tmp_path, 2, new_params)
+            assert engine.poll_rollover() == 2
+            assert engine.draining and engine.scheduler.n_inflight > 0
+            staged = True
+        completions.extend(engine.tick())
+        vc.t += TICK_S
+        ticks += 1
+        assert ticks < 20000, "drill did not terminate"
+
+    # ---- lifecycle contract: zero silent drops
+    assert set(engine.outcomes) == submitted
+    n_completed = sum(
+        1 for o in engine.outcomes.values() if o == "completed"
+    )
+    n_shed = sum(1 for o in engine.outcomes.values() if o == "shed")
+    n_expired = sum(1 for o in engine.outcomes.values() if o == "expired")
+    assert n_completed == len(completions)
+    assert n_completed + n_shed + n_expired == len(submitted)
+    # overload was real: arrivals exceeded capacity and the engine said
+    # no (shed) and gave up on the hopeless (expired)
+    assert n_shed >= 1, engine.outcomes
+    assert n_expired >= 1, engine.outcomes
+    assert n_completed >= 1, engine.outcomes
+
+    # ---- every termination carries a matching structured event
+    terminal = {
+        "request_done": "completed",
+        "request_shed": "shed",
+        "deadline_expired": "expired",
+    }
+    seen_rids = []
+    for e in events:
+        validate_event(dict(e))
+        if e["kind"] in terminal:
+            seen_rids.append(e["rid"])
+            assert engine.outcomes[e["rid"]] == terminal[e["kind"]]
+    assert sorted(seen_rids) == sorted(submitted)  # exactly once each
+
+    # ---- admitted-request p99 TTFT within the SLO budget: completions
+    # AND mid-decode expiries (any request that got a first token)
+    ttft = np.asarray(
+        [c.latencies_s[0] for c in completions]
+        + [e.ttft_s for e in engine.expired if e.ttft_s is not None]
+    )
+    assert float(np.percentile(ttft, 99)) <= SLO_BUDGET_S
+
+    # ---- the corrupt staged checkpoint aborted onto the old weights
+    assert engine.step == 1 and engine.rollovers == []
+    assert len(engine.rollover_aborts) == 1
+    assert engine.rollover_aborts[0]["reason"] == "corrupt_staged"
+    assert any(e["kind"] == "rollover_abort" for e in events)
+    for c in completions:
+        assert c.weights_step == 1
+    # token-exact service on the old weights after the abort
+    probe = dataclasses.replace(
+        _requests([(5, 6)], seed=9)[0], rid=9000
+    )
+    (out,) = engine.decode_requests([probe])
+    np.testing.assert_array_equal(
+        np.asarray(out.tokens), _oracle(old_params, probe)
+    )
+
+    # ---- the summary accounts for the whole story
+    s = summarize(completions, vc.t, engine)
+    assert s["requests_submitted"] == len(submitted) + 1  # + the probe
+    assert s["requests_shed"] == n_shed
+    assert s["requests_expired"] == n_expired
+    assert s["goodput_tokens"] <= s["new_tokens"]
+    assert s["rollover_aborts"][0]["staged_step"] == 2

@@ -9,8 +9,11 @@
 # vocab-parallel embedding + the LM evaluator with KV-cache sampling,
 # the serving engine under open-loop traffic with one hot checkpoint
 # rollover, the observability leg (traced train + serve merged into one
-# Chrome timeline by tools/trace_report.py), and the headline benchmark
-# in its trimmed form. Budget ~7 minutes of CPU (compiles dominate).
+# Chrome timeline by tools/trace_report.py), the serve-chaos leg
+# (traffic spike + decode stalls + corrupt staged rollover -> shed
+# events, full lifecycle accounting, rollover abort onto old weights),
+# and the headline benchmark in its trimmed form. Budget ~8 minutes of
+# CPU (compiles dominate).
 #
 #   bash tools/smoke.sh
 set -euo pipefail
@@ -171,6 +174,54 @@ print("obs smoke: %d phases merged (train+serve), %d span events, "
       "dispatch fraction %.2f"
       % (len(s["phases"]), len(spans), frac.get("dispatch", 0.0)))
 PYEOF
+
+# serve-chaos leg (ARCHITECTURE §7i): the same LM under fire on the
+# 8-dev mesh — a 5x seeded traffic spike, injected slow_decode stalls,
+# per-request deadlines, SLO-aware admission, and a rollover_corrupt
+# fault that truncates the staged step-20 checkpoint the moment it is
+# staged. Every request must terminate with exactly one lifecycle event
+# (zero silent drops), sheds must fire, the rollover must ABORT onto
+# the step-10 weights (service continues), and the chaos trace must
+# merge under --require-phases. Runs after the obs leg: it damages the
+# step-20 checkpoint file for good.
+run python -m ps_pytorch_tpu.cli.serve \
+    --model-dir "$TMP/lm" --step 10 --slots 8 --max-len 64 \
+    --requests 64 --rate 40 --prompt-min 4 --prompt-max 12 \
+    --new-min 8 --new-max 16 --poll-interval 0.05 --num-workers 8 \
+    --deadline 2.0 --slo-budget 0.25 --admit-window 0.1 \
+    --traffic-spike 5,0,2 --drain-timeout 5 \
+    --fault-plan '{"slow_decode":[2,3,4,5,6,7,8,9,10,11,12,13,14,15],"slow_decode_s":0.05,"rollover_corrupt":[20]}' \
+    --events "$TMP/chaos_events.jsonl" --summary-file "$TMP/chaos.json" \
+    --trace "$TMP/chaos_trace"
+run python - "$TMP/chaos.json" "$TMP/chaos_events.jsonl" <<'PYEOF'
+import json, sys
+from ps_pytorch_tpu.obs.schema import validate_event
+s = json.load(open(sys.argv[1]))
+assert s["requests_submitted"] == 64, s
+assert (s["requests_completed"] + s["requests_shed"]
+        + s["requests_expired"]) == 64, s
+assert s["requests_shed"] >= 1, s           # the controller said no
+assert s["weights_step"] == 10 and s["rollovers"] == [], s
+assert len(s["rollover_aborts"]) == 1, s
+assert s["rollover_aborts"][0]["reason"] == "corrupt_staged", s
+events = [json.loads(l) for l in open(sys.argv[2])]
+for e in events:
+    validate_event(dict(e))
+terminal = {"request_done", "request_shed", "deadline_expired"}
+rids = sorted(e["rid"] for e in events if e["kind"] in terminal)
+assert rids == list(range(64)), rids        # every request, exactly once
+assert any(e["kind"] == "rollover_abort" for e in events), "no abort event"
+assert any(e["kind"] == "admission_adapt" for e in events), "no adapt event"
+print("serve-chaos smoke: %d completed / %d shed / %d expired, rollover "
+      "10->20 aborted (corrupt_staged), goodput %.1f tok/s"
+      % (s["requests_completed"], s["requests_shed"], s["requests_expired"],
+         s["goodput_tokens_per_sec"] or 0.0))
+PYEOF
+run python tools/trace_report.py "$TMP/chaos_trace" \
+    --out "$TMP/chaos_trace_merged.json" \
+    --summary-out "$TMP/chaos_trace_summary.json" \
+    --require-phases admit_prefill,decode_dispatch,token_fetch,evict,rollover_drain,request \
+    > /dev/null
 
 # autotune leg (ARCHITECTURE §7h): trace-only knob search over the
 # trimmed LeNet grid on the 8-dev CPU mesh — candidates are pruned by
