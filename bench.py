@@ -442,14 +442,60 @@ def _overlap_tag() -> str:
     return ""
 
 
-def _comm_contract_entry(workload: str, compress, bucket_bytes):
+# BENCH_AB_WIRE=1 runs the CNN workload TWICE in one process —
+# PSConfig.wire_domain="dequant" then "homomorphic" on the same
+# compressed wire (§6h) — and emits both in ONE record: per-variant step
+# walltime, compiled hlo_op_count, backend stamp, and the committed
+# contract's comm shape incl. the gradient-path wire bytes, so the
+# record shows the compressed-domain byte shrink next to the measured
+# walltime. Needs a compressed BENCH_COMPRESS (the homomorphic domain
+# has nothing to sum on an f32 wire); mutually exclusive with the other
+# A/B dimensions.
+def _wire_tag() -> str:
+    if os.environ.get("BENCH_AB_WIRE") == "1":
+        return "_ab_wire"
+    return ""
+
+
+def _grad_wire_bytes(entry) -> int:
+    """Gradient-path payload bytes from a contract entry's rows: drop
+    the declared overheads — scale pmax rows, the guard pmin, the
+    <= 64 B metrics psum scalars, and (on a compressed wire) every f32
+    psum: in a compressed config the gradient reduce is integer by
+    construction, so a fat f32 psum is statistics (ResNet's BatchNorm
+    pmean — the contract's own allowance calls it "model state, not
+    gradients"), never payload. f32 GATHER rows stay counted: the
+    dequant hier wire's f32 reassembly all_gather is exactly the
+    gradient-path widening the homomorphic A/B exists to show."""
+    rows = entry["collectives"]
+    # integer PAYLOAD rows mark a compressed wire — the guard's int32
+    # pmin is overhead, not evidence of one
+    compressed = any(
+        r["dtype"].startswith("int") for r in rows
+        if r["kind"] not in ("pmax", "pmin")
+    )
+    total = 0
+    for r in rows:
+        if r["kind"] in ("pmax", "pmin"):
+            continue
+        if r["dtype"] == "float32" and (
+            r["bytes"] <= 64 or (compressed and r["kind"] == "psum")
+        ):
+            continue
+        total += r["bytes"]
+    return total
+
+
+def _comm_contract_entry(workload: str, compress, bucket_bytes,
+                         wire_domain: str = "dequant"):
     """The committed pscheck accounting row for the PS config this CNN
-    workload trains: {config, n_collectives, wire_bytes, mesh_devices}
-    from runs/comm_contract.json, or None when the registry has no
-    matching traced entry. Contract entries are keyed by config name and
-    traced with a FIXED bucket plan (LeNet variants pin the fused plan,
-    ResNet the 4 MiB plan), so only exact bucket matches attach —
-    mislabeling a different carving would be worse than omitting."""
+    workload trains: {config, n_collectives, wire_bytes,
+    grad_wire_bytes, mesh_devices} from runs/comm_contract.json, or
+    None when the registry has no matching traced entry. Contract
+    entries are keyed by config name and traced with a FIXED bucket
+    plan (LeNet variants pin the fused plan, ResNet the 4 MiB plan), so
+    only exact bucket matches attach — mislabeling a different carving
+    would be worse than omitting."""
     name = "ps_"
     if workload == "resnet18":
         name += "resnet18_"
@@ -464,6 +510,8 @@ def _comm_contract_entry(workload: str, compress, bucket_bytes):
             traced_bb = 0  # LeNet variants are traced with the fused plan
         if bucket_bytes != traced_bb:
             return None
+    if wire_domain == "homomorphic":
+        name += "_homomorphic"
     here = os.path.dirname(os.path.abspath(__file__))
     try:
         with open(os.path.join(here, "runs", "comm_contract.json")) as f:
@@ -475,6 +523,7 @@ def _comm_contract_entry(workload: str, compress, bucket_bytes):
         "config": name,
         "n_collectives": entry["n_collectives"],
         "wire_bytes": entry["total_bytes"],
+        "grad_wire_bytes": _grad_wire_bytes(entry),
         "mesh_devices": data.get("mesh_devices"),
     }
 
@@ -704,10 +753,11 @@ def _validate_env() -> None:
     # AB=0 is the documented "off" value — as inert as unset, so a CI
     # wrapper exporting it globally must not abort the lm/decode legs
     for knob in ("BENCH_BUCKET_BYTES", "BENCH_AB_BUCKETING",
-                 "BENCH_AB_STATE_LAYOUT", "BENCH_AB_OVERLAP"):
+                 "BENCH_AB_STATE_LAYOUT", "BENCH_AB_OVERLAP",
+                 "BENCH_AB_WIRE"):
         val = os.environ.get(knob)
         if knob in ("BENCH_AB_BUCKETING", "BENCH_AB_STATE_LAYOUT",
-                    "BENCH_AB_OVERLAP") and val == "0":
+                    "BENCH_AB_OVERLAP", "BENCH_AB_WIRE") and val == "0":
             val = None
         if val is not None and os.environ.get(
             "BENCH_WORKLOAD", "lenet"
@@ -718,7 +768,7 @@ def _validate_env() -> None:
             )
     ab_on = [
         k for k in ("BENCH_AB_BUCKETING", "BENCH_AB_STATE_LAYOUT",
-                    "BENCH_AB_OVERLAP")
+                    "BENCH_AB_OVERLAP", "BENCH_AB_WIRE")
         if os.environ.get(k) == "1"
     ]
     if len(ab_on) > 1:
@@ -726,6 +776,16 @@ def _validate_env() -> None:
             f"{' and '.join(ab_on)} are mutually exclusive — one A/B "
             "dimension per record"
         )
+    if os.environ.get("BENCH_AB_WIRE") == "1":
+        name = os.environ.get("BENCH_WORKLOAD", "lenet")
+        mode, _ = _cnn_compress(WORKLOADS.get(name, {}).get("compress"))
+        if mode in (None, "none"):
+            raise SystemExit(
+                "BENCH_AB_WIRE needs a compressed wire (the homomorphic "
+                "domain has nothing to sum on an f32 psum) — set "
+                "BENCH_COMPRESS=int8 or int8_2round, or pick a workload "
+                "whose canonical mode is compressed (resnet18)"
+            )
     if os.environ.get("BENCH_BUCKET_BYTES") is not None:
         try:
             bb = int(os.environ["BENCH_BUCKET_BYTES"])
@@ -748,7 +808,7 @@ def _validate_env() -> None:
                 "or unset it for the 64 KiB default"
             )
     for knob in ("BENCH_AB_BUCKETING", "BENCH_AB_STATE_LAYOUT",
-                 "BENCH_AB_OVERLAP"):
+                 "BENCH_AB_OVERLAP", "BENCH_AB_WIRE"):
         if os.environ.get(knob) not in (None, "0", "1"):
             raise SystemExit(
                 f"{knob} must be 0 or 1, got {os.environ[knob]!r}"
@@ -856,7 +916,7 @@ def _success_metric() -> str:
     metric = WORKLOADS.get(name, {}).get("metric") or f"{name}_train_throughput"
     _, ctag = _cnn_compress(WORKLOADS.get(name, {}).get("compress"))
     return (metric + ctag + _bucket_tag() + _layout_tag()
-            + _overlap_tag() + _cnn_dtype_suffix())
+            + _overlap_tag() + _wire_tag() + _cnn_dtype_suffix())
 
 
 def _attach_banked(rec: dict) -> None:
@@ -1065,7 +1125,8 @@ def main() -> None:
 
     def run_variant(bucket_bytes, state_layout="flat",
                     probe_update_path=False, overlap="serial",
-                    probe_overlap=False, spans=False):
+                    probe_overlap=False, spans=False,
+                    wire_domain="dequant"):
         """Measure one (wire granularity, state layout, schedule) end to
         end; returns the variant's sub-record plus (loss, elapsed,
         steps, flops, chain). ``spans`` wraps the measured window in an
@@ -1077,7 +1138,7 @@ def main() -> None:
         cfg = PSConfig(
             num_workers=n_dev, compress=compress,
             bucket_bytes=bucket_bytes, state_layout=state_layout,
-            overlap=overlap,
+            overlap=overlap, wire_domain=wire_domain,
         )
         # the flat layout takes the whole-vector optimizer variant (the
         # trainer's own pairing); the math is bit-identical either way
@@ -1169,9 +1230,12 @@ def main() -> None:
             },
             # comm shape from the committed pscheck artifact, so the
             # perf trajectory records the wire, not just walltime
-            "comm": _comm_contract_entry(name, compress, bucket_bytes),
+            "comm": _comm_contract_entry(
+                name, compress, bucket_bytes, wire_domain
+            ),
         }
         sub["overlap"] = overlap
+        sub["wire_domain"] = wire_domain
         if update_ops is not None:
             sub["update_path_ops"] = update_ops
         if overlap_probe is not None:
@@ -1313,6 +1377,54 @@ def main() -> None:
                     / max(sub_ser["images_per_sec"], 1e-9),
                     3,
                 ),
+            },
+        }
+    elif os.environ.get("BENCH_AB_WIRE") == "1":
+        # A/B leg: dequant vs homomorphic WIRE DOMAIN in one process on
+        # the same compressed wire (§6h) — per-variant walltime,
+        # hlo_op_count, backend stamp, and the committed contract's
+        # gradient-path wire bytes land in one record, so the
+        # compressed-domain byte shrink and the measured walltime ride
+        # together. Headline = homomorphic.
+        bb = _bench_bucket_bytes()
+        sub_deq, *_ = run_variant(bb, wire_domain="dequant")
+        sub_hom, loss, elapsed, steps, flops, k = run_variant(
+            bb, wire_domain="homomorphic"
+        )
+        _require_same_backend(sub_deq, sub_hom)
+        images_per_sec = sub_hom["images_per_sec"]
+        wire_ratio = None
+        if (sub_deq.get("comm") and sub_hom.get("comm")
+                and sub_hom["comm"]["grad_wire_bytes"]):
+            wire_ratio = round(
+                sub_deq["comm"]["grad_wire_bytes"]
+                / sub_hom["comm"]["grad_wire_bytes"], 3,
+            )
+        rec = {
+            "run": _run_info(n_dev, device_kind),
+            "phases": sub_hom["phases"],
+            "metric": _success_metric() + suffix,
+            "value": images_per_sec,
+            "unit": "images/sec",
+            "vs_baseline": round(images_per_sec / REF_IMAGES_PER_SEC, 2),
+            "mfu": _mfu(flops, steps, elapsed, jax, n_devices=n_dev),
+            "device": device_kind,
+            "backend": _backend_info(device_kind),
+            "timestamp": _utc_now(),
+            "hlo_op_count": sub_hom["hlo_op_count"],
+            "comm": sub_hom["comm"],
+            "ab_wire": {
+                "dequant": sub_deq,
+                "homomorphic": sub_hom,
+                "speedup": round(
+                    sub_hom["images_per_sec"]
+                    / max(sub_deq["images_per_sec"], 1e-9),
+                    3,
+                ),
+                # the committed-contract byte shrink (dequant /
+                # homomorphic gradient-path wire bytes), when both
+                # carvings have traced entries
+                "grad_wire_bytes_ratio": wire_ratio,
             },
         }
     else:

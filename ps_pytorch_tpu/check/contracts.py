@@ -321,6 +321,7 @@ def _ps_spec(
     overlap: str = "serial",
     bucket_tag: str = "",
     quant_block_size: int = 0,
+    wire_domain: str = "dequant",
 ) -> ContractSpec:
     from ..parallel.mesh import DCN_AXIS, WORKER_AXIS
 
@@ -340,6 +341,9 @@ def _ps_spec(
         # exactly that as a pruning constraint), so it must be visible
         # in the config name
         name += f"_qb{quant_block_size}"
+    homomorphic = wire_domain == "homomorphic"
+    if homomorphic:
+        name += "_homomorphic"
     if adaptive:
         name += "_adaptive"
     if overlap == "pipelined":
@@ -366,6 +370,7 @@ def _ps_spec(
             state_layout=state_layout,
             overlap=overlap,
             quant_block_size=quant_block_size,
+            wire_domain=wire_domain,
             num_aggregate_min=2 if adaptive else None,
             num_aggregate_max=MESH_DEVICES if adaptive else None,
         )
@@ -386,7 +391,18 @@ def _ps_spec(
 
     wire = None
     if compress == "int8_2round":
-        allow = [_METRICS_PSUM, _SCALE_PMAX, _SCALE_GATHER, _FINITE_PMIN]
+        if homomorphic:
+            # compressed-domain wire (§6h): round 2's requantization is
+            # a lattice rescale with the round-1 scales everyone already
+            # holds — the f32 scale-row gather allowance disappears, and
+            # the hierarchical reassembly gathers int8 payload so its
+            # f32 allowance disappears too. The allowance list is
+            # STRICTLY SMALLER than the dequant twin's; that shrink is
+            # the proof mechanism the homomorphic mode banks on.
+            allow = [_METRICS_PSUM, _SCALE_PMAX, _FINITE_PMIN]
+        else:
+            allow = [_METRICS_PSUM, _SCALE_PMAX, _SCALE_GATHER,
+                     _FINITE_PMIN]
         if bn_state_bytes(network):
             # BatchNorm running stats (bn_mode="pmean", the default)
             # ride an f32 psum sized by the model's own state tree —
@@ -407,7 +423,7 @@ def _ps_spec(
                            "bcast analogue; §6b sharded placement)",
                 )
             )
-        if dcn_hosts > 1:
+        if dcn_hosts > 1 and not homomorphic:
             allow.append(
                 WireAllowance(
                     kind="all_gather", dtype="float32", max_bytes=None,
@@ -419,6 +435,38 @@ def _ps_spec(
             )
         wire = WirePolicy(axes=axes, payload_dtype="int8",
                           allow=tuple(allow))
+    elif compress == "int8" and homomorphic:
+        # the dequant "int8" scheme cannot declare a wire policy at all
+        # (its psum payload is int32 by design); the homomorphic twin
+        # CAN — the payload IS the minimal exact accumulator
+        # (ops/quantize.accum_dtype: int16 on the 8-device registry
+        # mesh), and any f32/int32 widening back onto the wire trips
+        # PSC103. New policing the dequant twin never had.
+        from ..ops.quantize import accum_dtype
+
+        import jax.numpy as jnp
+
+        allow = [_METRICS_PSUM, _SCALE_PMAX, _FINITE_PMIN]
+        if bn_state_bytes(network):
+            allow.append(WireAllowance(
+                kind="psum", dtype="float32",
+                max_bytes=bn_state_bytes(network),
+                reason="BatchNorm cross-replica stats pmean "
+                       "(bn_mode=pmean; model state, not gradients)",
+            ))
+        if placement == "sharded":
+            allow.append(
+                WireAllowance(
+                    kind="all_gather", dtype="float32", max_bytes=None,
+                    reason="ZeRO-1 f32 update all_gather (the weight "
+                           "bcast analogue; §6b sharded placement)",
+                )
+            )
+        wire = WirePolicy(
+            axes=axes,
+            payload_dtype=jnp.dtype(accum_dtype(MESH_DEVICES)).name,
+            allow=tuple(allow),
+        )
 
     fusion = None
     if bucket_bytes is not None or placement == "sharded":
@@ -787,6 +835,36 @@ def get_contracts() -> Tuple[ContractSpec, ...]:
         )
     )
     specs.append(_ps_spec("int8", "sharded", overlap="pipelined"))
+    # homomorphic (compressed-domain) twins of the committed int8 wires
+    # (§6h, wire_domain="homomorphic"): the artifact rows document the
+    # f32 widening leaving the wire — the "int8" psum narrows int32 ->
+    # int16, the 2round gather hop drops its f32 scale rows, and the
+    # hierarchical twin's ICI reassembly shrinks f32 -> int8 (4x). Each
+    # twin's PSC103 allowance list is strictly smaller than (or, for
+    # "int8", newly existent vs) its dequant twin's.
+    specs.append(_ps_spec("int8", "replicated", wire_domain="homomorphic"))
+    specs.append(_ps_spec("int8", "sharded", wire_domain="homomorphic"))
+    specs.append(_ps_spec("int8_2round", "replicated", bucket_bytes=0,
+                          wire_domain="homomorphic"))
+    specs.append(_ps_spec("int8_2round", "sharded",
+                          wire_domain="homomorphic"))
+    specs.append(_ps_spec("int8_2round", "replicated", dcn_hosts=2,
+                          bucket_bytes=0, wire_domain="homomorphic"))
+    # the cost-model leg: the flagship ResNet18 bucketed int8 wire in
+    # the compressed domain (tests/test_tune.py pins that the model
+    # ranks it <= the dequant twin), plus a pipelined 64 KiB pair so
+    # PSC109's same-bytes/per-bucket-dispatch pins hold on the
+    # homomorphic wire too
+    specs.append(
+        _ps_spec(
+            "int8", "replicated", network="ResNet18",
+            bucket_bytes=RESNET_BUCKET_BYTES, wire_domain="homomorphic",
+        )
+    )
+    for ov in ("serial", "pipelined"):
+        specs.append(_ps_spec("int8", "replicated", bucket_bytes=64 << 10,
+                              bucket_tag="64k", overlap=ov,
+                              wire_domain="homomorphic"))
     specs.extend(
         [_dp_tp_spec(), _pp_spec(), _moe_spec(), _dp_tp_pp_spec()]
     )

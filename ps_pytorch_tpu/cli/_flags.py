@@ -190,6 +190,19 @@ def add_ps_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--quant-rounding", type=str, default="nearest",
                         choices=("nearest", "stochastic"),
                         help="stochastic = unbiased gradient quantization")
+    parser.add_argument("--wire-domain", type=str, default="dequant",
+                        choices=("dequant", "homomorphic"),
+                        help="what the aggregation sums (§6h): dequant = "
+                             "widen each quantized hop to f32 to add; "
+                             "homomorphic = sum in the compressed domain "
+                             "(shared per-bucket scales, exact integer "
+                             "accumulation, one deferred scale-multiply "
+                             "per bucket at the consumer — the int8 psum "
+                             "narrows to int16, the 2round wire drops its "
+                             "round-2 scale rows, the hier DCN x ICI "
+                             "reassembly ships int8 instead of f32). "
+                             "Needs a --compress-grad mode and nearest "
+                             "rounding")
     parser.add_argument("--opt-placement", type=str, default="replicated",
                         choices=("replicated", "sharded"),
                         help="where optimizer state lives (sharded = ZeRO-1 PS)")
@@ -388,6 +401,7 @@ def ps_config_from(args: argparse.Namespace, num_workers: int) -> PSConfig:
         }[args.compress_grad],
         quant_block_size=args.quant_block_size,
         quant_rounding=args.quant_rounding,
+        wire_domain=args.wire_domain,
         bucket_bytes=(
             None if args.bucket_bytes < 0 else args.bucket_bytes
         ),

@@ -202,6 +202,110 @@ def dequantize_int8(
     return out
 
 
+# ------------------------------------------- homomorphic (compressed-domain)
+
+
+_INT8_PEAK = 127  # symmetric int8 payloads live in [-127, 127]
+
+# exact-sum capacity per accumulator dtype: the largest number of
+# full-scale (|q| = 127) int8 payloads whose sum provably fits. int16
+# holds 258 (258 * 127 = 32766 <= 32767), int32 holds 16_909_320
+# (16_909_320 * 127 = 2_147_483_640 <= 2^31 - 1).
+ACCUM_CAPACITY = {
+    "int16": (2 ** 15 - 1) // _INT8_PEAK,
+    "int32": (2 ** 31 - 1) // _INT8_PEAK,
+}
+
+
+def accum_dtype(num_summands: int):
+    """Smallest integer dtype whose range provably holds a sum of
+    ``num_summands`` full-scale int8 payloads — the wire dtype of a
+    homomorphic psum (collectives.quantized_psum with
+    wire_domain="homomorphic"). The sum of n values in [-127, 127] is
+    bounded by n * 127, so the choice is a static function of the mesh
+    size: int16 through 258 workers (2 bytes/element on the wire vs 4
+    for the dequant path's int32), int32 through ~16.9M. Beyond that no
+    supported accumulator is exact — raise rather than wrap."""
+    if num_summands < 1:
+        raise ValueError(f"accum_dtype needs >= 1 summand, got {num_summands}")
+    if num_summands <= ACCUM_CAPACITY["int16"]:
+        return jnp.int16
+    if num_summands <= ACCUM_CAPACITY["int32"]:
+        return jnp.int32
+    raise ValueError(
+        f"homomorphic accumulation over {num_summands} full-scale int8 "
+        f"payloads can overflow int32 (capacity "
+        f"{ACCUM_CAPACITY['int32']}) — use wire_domain='dequant'"
+    )
+
+
+def homomorphic_rescale(acc: jax.Array, divisor) -> jax.Array:
+    """Integer lattice rescale: ``round(acc / divisor)`` back to int8.
+
+    ``acc`` is an exact integer accumulation of at most ``divisor``
+    int8 payloads on a SHARED quantization lattice (|acc| <= divisor *
+    127), so the rounded quotient provably fits [-127, 127] — the
+    compressed-domain replacement for the dequant wire's round-2
+    widen -> requantize: no f32 on the wire, no new scale rows, one
+    deterministic rounding at the shared scale's granularity.
+    ``divisor`` may be a traced scalar (the adaptive aggregation
+    count). The divide runs in f32 COMPUTE (never on the wire), which
+    represents the accumulator exactly through 2^24 — every mesh the
+    int16/int32 capacity table admits below ~132k workers."""
+    q = jnp.round(acc.astype(jnp.float32) / divisor)
+    return jnp.clip(q, -_INT8_PEAK, _INT8_PEAK).astype(jnp.int8)
+
+
+def _accum_rescale_kernel(recv_ref, div_ref, out_ref):
+    acc = jnp.sum(recv_ref[:].astype(jnp.int32), axis=0, keepdims=True)
+    q = jnp.round(acc.astype(jnp.float32) / div_ref[0, 0])
+    out_ref[:] = jnp.clip(q, -127.0, 127.0).astype(jnp.int8)
+
+
+def _pallas_accum_rescale(recv: jax.Array, divisor, mode: dict) -> jax.Array:
+    """recv: int8 [n, s] with s % 128 == 0 -> int8 [s]."""
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    n, s = recv.shape
+    # VMEM budget: the n x block_s int8 tile (plus int32 widening) must
+    # fit on chip; 16Ki lanes x n<=~258 rows stays well under it
+    block_s = min(s, 16384 // _LANE * _LANE)
+    out = pl.pallas_call(
+        _accum_rescale_kernel,
+        out_shape=jax.ShapeDtypeStruct((1, s), jnp.int8),
+        grid=(pl.cdiv(s, block_s),),
+        in_specs=[
+            pl.BlockSpec((n, block_s), lambda i: (0, i),
+                         memory_space=pltpu.VMEM),
+            pl.BlockSpec(memory_space=pltpu.SMEM),
+        ],
+        out_specs=pl.BlockSpec((1, block_s), lambda i: (0, i),
+                               memory_space=pltpu.VMEM),
+        **mode,
+    )(recv, jnp.asarray(divisor, jnp.float32).reshape(1, 1))
+    return out.reshape(-1)
+
+
+def accumulate_rescale_int8(recv: jax.Array, divisor) -> jax.Array:
+    """The homomorphic gather hop's fused hot path: exact integer
+    accumulation over the worker rows of an all_to_all'd int8 payload
+    ``[n, s]`` + lattice rescale back to int8 — the compressed-domain
+    replacement for the dequant wire's widen -> requantize, fused into
+    ONE Pallas VPU pass on TPU (int8 load, int32 accumulate, f32
+    divide/round, int8 store: no widened intermediate ever reaches HBM).
+    Exercised on CPU via PS_TPU_PALLAS_INTERPRET=1 like the flash
+    kernels; the pure-jnp path is bit-identical (same sum, same f32
+    divide, same round-half-even). ``divisor`` may be traced (the
+    adaptive aggregation count rides the SMEM scalar operand)."""
+    mode = _pallas_mode(recv)
+    if mode is not None and recv.shape[1] % _LANE == 0:
+        return _pallas_accum_rescale(recv, divisor, mode)
+    return homomorphic_rescale(
+        jnp.sum(recv.astype(jnp.int32), axis=0), divisor
+    )
+
+
 def quantization_error(x: jax.Array, block_size: int = 0) -> jax.Array:
     """Max abs round-trip error — used by tests and for Msg(MB)-style
     introspection (the reference logs compressed message sizes,

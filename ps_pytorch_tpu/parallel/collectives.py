@@ -26,7 +26,12 @@ import jax
 import jax.numpy as jnp
 from jax import lax
 
-from ..ops.quantize import dequantize_int8, quantize_int8
+from ..ops.quantize import (
+    accum_dtype,
+    accumulate_rescale_int8,
+    dequantize_int8,
+    quantize_int8,
+)
 from .buckets import piece_stream
 
 
@@ -136,6 +141,8 @@ def quantized_psum(
     flat_output: bool = False,
     pipelined: bool = False,
     bucket_output: bool = False,
+    wire_domain: str = "dequant",
+    num_workers: Optional[int] = None,
 ):
     """int8-quantized gradient all-reduce.
 
@@ -148,12 +155,33 @@ def quantized_psum(
     averages out across the psum instead of accumulating (capabilities beyond
     the reference's lossless-but-slow Blosc path).
 
+    ``wire_domain="homomorphic"`` (PSConfig.wire_domain) is the THC-style
+    compressed-domain spelling of the same sum: the scales are already
+    shared (the pmax), so the psum rides the MINIMAL exact accumulator
+    dtype for ``num_workers`` summands (ops/quantize.accum_dtype — int16
+    through 258 workers, halving the dequant path's int32 wire) and the
+    division by ``denominator`` folds into the single deferred
+    scale-multiply at the consumer. The accumulation itself is bit-exact
+    either way (integer sums); only the wire bytes and the final
+    multiply's association differ.
+
     A piece is one pytree leaf (``bucket_bytes=None``, the reference's
     message-per-layer shape) or one fused flat bucket (buckets.py) — the
     latter collapses O(n_leaves) pmax+psum pairs into O(n_buckets), with
     bucket boundaries aligned to ``block_size`` so no scale row straddles
     buckets and PRNG keys folded by bucket start offset (position-stable).
     """
+    if wire_domain == "homomorphic":
+        if num_workers is None:
+            raise ValueError(
+                "homomorphic quantized_psum needs num_workers (it sizes "
+                "the exact accumulator dtype)"
+            )
+        if rounding == "stochastic":
+            raise ValueError(
+                "homomorphic wire needs rounding='nearest' (per-worker "
+                "stochastic noise is incoherent on a shared lattice)"
+            )
     if rounding == "stochastic":
         if key is None:
             raise ValueError("stochastic rounding needs a key")
@@ -169,6 +197,14 @@ def quantized_psum(
             rounding=rounding,
             key=leaf_key,
         )
+        if wire_domain == "homomorphic":
+            # compressed-domain sum: narrow exact accumulator on the
+            # wire, ONE deferred scale-multiply (the denominator folds
+            # into the shared scale) at the consumer
+            s = lax.psum(q.astype(accum_dtype(num_workers)), axis_name)
+            return dequantize_int8(
+                s, scale / denominator, block_size=block_size, shape=g.shape
+            )
         s = lax.psum(q.astype(jnp.int32), axis_name)
         deq = dequantize_int8(s, scale, block_size=block_size, shape=g.shape)
         return deq / denominator
@@ -221,6 +257,39 @@ def _q2r_scatter_stage(g32, axis_name, n, s, block_size, rounding, leaf_key):
     return partial
 
 
+def _q2r_scatter_stage_hom(g32, wire_axis, scale_axes, n, s, block_size):
+    """Homomorphic round 1 for one flat padded [n*s] piece: SHARED-scale
+    (pmax over ``scale_axes`` — the whole reducing axis set, so one scale
+    row set serves every worker) int8 quantize -> all_to_all int8 over
+    ``wire_axis``. Returns ``(recv [n, s] int8, scale)`` — the received
+    worker rows of MY region, un-accumulated so the caller can fuse the
+    exact integer accumulation with its lattice rescale
+    (ops/quantize.accumulate_rescale_int8, one Pallas VPU pass on TPU).
+    The scale rows cover the WHOLE padded vector and are replicated on
+    every worker by the pmax, so any consumer can dequantize any region
+    with zero scale traffic."""
+    q1, scale1 = quantize_int8(
+        g32, axis_name=scale_axes, block_size=block_size
+    )
+    q1 = q1.reshape(n, s).astype(jnp.int8)
+    recv = lax.all_to_all(q1, wire_axis, split_axis=0, concat_axis=0,
+                          tiled=True)
+    return recv, scale1
+
+
+def _deq_shared(full, scale, gain, block_size):
+    """THE single deferred scale-multiply of the homomorphic wire: int8
+    payload x (shared scale x gain) -> f32, per block row or per tensor.
+    ``gain`` folds the lattice-rescale factors and the aggregation
+    denominator back in (it may be traced)."""
+    if block_size:
+        return (
+            full.reshape(-1, block_size).astype(jnp.float32)
+            * (scale * gain)
+        ).reshape(-1)
+    return full.astype(jnp.float32) * (scale * gain)
+
+
 def _q2r_gather_stage(partial, axis_name, n, s, block_size, rounding, key2):
     """Round 2: requantize the [s] partial sum with LOCAL scales (regions
     are disjoint, so no cross-worker scale agreement is needed) and
@@ -255,6 +324,7 @@ def quantized_allreduce_2round(
     flat_output: bool = False,
     pipelined: bool = False,
     bucket_output: bool = False,
+    wire_domain: str = "dequant",
 ):
     """Two-round int8 all-reduce whose WIRE traffic is actually int8.
 
@@ -278,8 +348,27 @@ def quantized_allreduce_2round(
     (tests/test_compression.py::test_ef_untracked_round2_noise_measured).
     The result is identical on every worker by construction (it is
     all_gathered).
+
+    ``wire_domain="homomorphic"``: round 2's widen -> requantize (and its
+    f32 scale-row gather) disappears entirely — the exact int32
+    accumulation of MY region is lattice-rescaled by the aggregation
+    denominator (``ops/quantize.homomorphic_rescale``: round(acc / k)
+    provably fits int8, since |acc| <= k * 127 on the shared lattice),
+    all_gathered as int8, and dequantized by ONE deferred scale-multiply
+    with the round-1 scale rows every worker already holds from the
+    pmax. The only lossy step beyond round 1 is that single deterministic
+    rounding at the shared scale's granularity (vs the dequant path's
+    adaptively-rescaled round-2 requantization — comparable envelope,
+    zero extra wire rows). Requires ``rounding="nearest"`` (PSConfig
+    enforces it: per-worker stochastic noise has no coherent meaning on
+    a shared lattice rescale).
     """
     n = num_workers
+    if wire_domain == "homomorphic" and rounding == "stochastic":
+        raise ValueError(
+            "homomorphic wire needs rounding='nearest' (per-worker "
+            "stochastic noise is incoherent on a shared lattice)"
+        )
     # same key discipline as quantized_psum / local_quantized_contribution
     # (fold worker first, leaf second) so error-feedback residuals mirror
     # the transmitted values exactly
@@ -293,6 +382,15 @@ def quantized_allreduce_2round(
         total = g32.shape[0]
         s = _slice_len(total, n, block_size)
         g32 = jnp.pad(g32, (0, n * s - total))
+        if wire_domain == "homomorphic":
+            recv, scale1 = _q2r_scatter_stage_hom(
+                g32, axis_name, axis_name, n, s, block_size
+            )
+            q2 = accumulate_rescale_int8(recv, denominator)
+            full = lax.all_gather(q2, axis_name, tiled=True)  # int8, no
+            # scale rows: every worker holds the shared rows already
+            deq = _deq_shared(full, scale1, 1.0, block_size)
+            return deq[:total].reshape(g.shape)  # denominator folded in
         leaf_key = jax.random.fold_in(key, i) if key is not None else None
         partial = _q2r_scatter_stage(
             g32, axis_name, n, s, block_size, rounding, leaf_key
@@ -326,6 +424,7 @@ def quantized_allreduce_2round_hier(
     flat_output: bool = False,
     pipelined: bool = False,
     bucket_output: bool = False,
+    wire_domain: str = "dequant",
 ):
     """Hierarchical (DCN x ICI) bandwidth-honest int8 all-reduce that
     crosses DCN exactly ONCE per gradient element.
@@ -349,9 +448,26 @@ def quantized_allreduce_2round_hier(
     Round-1 quantization (the EF contribution transform) is shared-scale
     over the ICI axis with the key pre-folded by DCN index — mirror it
     with local_quantized_contribution(axis_names[1], key=dcn_folded_key).
-    """
+
+    ``wire_domain="homomorphic"``: round-1 scales are shared GLOBALLY
+    (one pmax over BOTH axes — one scale row set serves every chip on
+    the mesh), so the accumulated payload stays on one lattice across
+    every hop and NOTHING ever widens to f32 on the wire: the ICI
+    partial sums lattice-rescale (/per_host) to int8 and cross DCN as
+    int8, the DCN sums rescale (/hosts) and gather back as int8, and —
+    the headline row — the ICI reassembly all_gather carries int8
+    instead of the dequant path's f32 (4x smaller; the PSC103 hier
+    reassembly allowance disappears). The consumer applies ONE deferred
+    scale-multiply with gain (per_host * hosts) / denominator folding
+    the exact aggregation count back in. Mirror the EF contribution
+    with local_quantized_contribution over the FULL axis tuple."""
     dcn_axis, ici_axis = axis_names
     hosts, per_host = axis_sizes
+    if wire_domain == "homomorphic" and rounding == "stochastic":
+        raise ValueError(
+            "homomorphic wire needs rounding='nearest' (per-worker "
+            "stochastic noise is incoherent on a shared lattice)"
+        )
     if rounding == "stochastic":
         if key is None:
             raise ValueError("stochastic rounding needs a key")
@@ -360,7 +476,39 @@ def quantized_allreduce_2round_hier(
         key = jax.random.fold_in(key, lax.axis_index(dcn_axis))
         key = jax.random.fold_in(key, lax.axis_index(ici_axis))
 
+    def one_hom(i, g):
+        g32 = g.astype(jnp.float32).reshape(-1)
+        total = g32.shape[0]
+        s1 = _slice_len(total, per_host, block_size)
+        g32 = jnp.pad(g32, (0, per_host * s1 - total))
+        # 1. ICI: shared-GLOBAL-scale quantize, int8 a2a, exact int sum
+        recv1, scale1 = _q2r_scatter_stage_hom(
+            g32, ici_axis, axis_names, per_host, s1, block_size
+        )
+        # 2. DCN hop forwards the accumulated payload on the SAME
+        # lattice: fused accumulate+rescale /per_host back into int8
+        # range (|acc| <= per_host * 127), a2a int8, fused
+        # accumulate+rescale /hosts
+        q_mid = accumulate_rescale_int8(recv1, float(per_host))
+        s2 = _slice_len(s1, hosts, block_size)
+        q_mid = jnp.pad(q_mid, (0, hosts * s2 - s1))
+        recv2 = lax.all_to_all(
+            q_mid.reshape(hosts, s2), dcn_axis, split_axis=0,
+            concat_axis=0, tiled=True,
+        )
+        q2 = accumulate_rescale_int8(recv2, float(hosts))
+        region = lax.all_gather(q2, dcn_axis, tiled=True)[:s1]
+        # 3. reassemble over ICI — int8, the hop the dequant path pays
+        # f32 for; then the single deferred scale-multiply, with the
+        # rescale factors and the true denominator folded into the gain
+        full = lax.all_gather(region, ici_axis, tiled=True)
+        gain = (per_host * hosts) / denominator
+        deq = _deq_shared(full, scale1, gain, block_size)
+        return deq[:total].reshape(g.shape)
+
     def one(i, g):
+        if wire_domain == "homomorphic":
+            return one_hom(i, g)
         g32 = g.astype(jnp.float32).reshape(-1)
         total = g32.shape[0]
         s1 = _slice_len(total, per_host, block_size)
@@ -455,6 +603,7 @@ def aggregate_gradients(
     flat_output: bool = False,
     pipelined: bool = False,
     bucket_output: bool = False,
+    wire_domain: str = "dequant",
 ):
     """The full PS aggregation: mask -> (bucket) -> (quantized) reduce -> / K.
 
@@ -496,6 +645,18 @@ def aggregate_gradients(
     no-mask path on power-of-two meshes) and the denominator is the
     traced count itself, so the aggregate stays an average over the
     selected set at every count without retracing."""
+    if wire_domain not in ("dequant", "homomorphic"):
+        raise ValueError(f"bad wire_domain {wire_domain!r}")
+    if wire_domain == "homomorphic":
+        if compress in (None, "none"):
+            raise ValueError(
+                "wire_domain='homomorphic' needs a compress mode — an "
+                "uncompressed f32 psum has no compressed domain to sum in"
+            )
+        if quant_rounding == "stochastic":
+            raise ValueError(
+                "wire_domain='homomorphic' needs quant_rounding='nearest'"
+            )
     dynamic = isinstance(num_aggregate, jax.Array)
     if dynamic:
         k = num_aggregate.astype(jnp.float32)
@@ -529,6 +690,8 @@ def aggregate_gradients(
             flat_output=flat_output,
             pipelined=pipelined,
             bucket_output=bucket_output,
+            wire_domain=wire_domain,
+            num_workers=num_workers,
         )
         contribution = None
     elif hier_2round:
@@ -549,6 +712,7 @@ def aggregate_gradients(
             flat_output=flat_output,
             pipelined=pipelined,
             bucket_output=bucket_output,
+            wire_domain=wire_domain,
         )
         contribution = None
     elif compress == "int8_2round":
@@ -564,6 +728,7 @@ def aggregate_gradients(
             flat_output=flat_output,
             pipelined=pipelined,
             bucket_output=bucket_output,
+            wire_domain=wire_domain,
         )
         contribution = None
     else:
@@ -582,8 +747,14 @@ def aggregate_gradients(
         contribution = local_quantized_contribution(
             grads,
             # hierarchical 2round quantizes round 1 with scales shared
-            # over the INNER (ICI) axis only
-            axis_name[1] if hier_2round else axis_name,
+            # over the INNER (ICI) axis only — except on the homomorphic
+            # wire, whose round-1 scales are GLOBAL (pmax over the full
+            # axis tuple), so the residual must mirror that
+            (
+                tuple(axis_name)
+                if hier_2round and wire_domain == "homomorphic"
+                else (axis_name[1] if hier_2round else axis_name)
+            ),
             block_size=quant_block_size,
             rounding=quant_rounding,
             key=contrib_key,

@@ -46,7 +46,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import apply_model
 from ..ops.metrics import accuracy, cross_entropy_loss
-from ..ops.quantize import dequantize_int8, quantize_int8
+from ..ops.quantize import accum_dtype, dequantize_int8, quantize_int8
 from ..resilience.guard import (
     init_guard_state,
     tree_all_finite,
@@ -109,6 +109,24 @@ class PSConfig:
     compress: Optional[str] = None
     quant_block_size: int = 0
     quant_rounding: str = "nearest"  # "nearest" | "stochastic" (unbiased)
+    # WHAT the aggregation sums (--wire-domain): "dequant" (default) is
+    # the committed-contract wire — each hop widens the quantized payload
+    # to f32 to add and requantizes to ship. "homomorphic" (THC/DynamiQ,
+    # PAPERS.md) sums in the COMPRESSED domain: workers agree on shared
+    # per-bucket scales via the one tiny max-abs reduction the quantizer
+    # already pays, payloads accumulate exactly in the minimal integer
+    # dtype (ops/quantize.accum_dtype pins the no-overflow bound), every
+    # wire hop carries int8/int16 — the "int8" psum halves (int16 vs
+    # int32), the 2-round gather hop drops its round-2 requantization
+    # and scale rows, and the hierarchical DCN x ICI path forwards
+    # lattice payloads across every hop (the f32 ICI reassembly becomes
+    # int8: 4x) — and dequantization defers to ONE scale-multiply per
+    # bucket at the consumer (the ZeRO-1 placement dequantizes only its
+    # own shard region). Needs a compress mode and nearest rounding;
+    # shared scales are COARSER than per-worker scales, so parity vs the
+    # dequant wire is an envelope (EF absorbs the difference), while the
+    # integer accumulation itself is bit-exact.
+    wire_domain: str = "dequant"
     # gradient wire granularity (parallel/buckets.py): None = legacy
     # message-per-leaf collectives (the reference's tag-88+l shape), 0 =
     # ONE fused flat f32 buffer, N = ~N-byte contiguous buckets with
@@ -238,6 +256,32 @@ class PSConfig:
                 f"bad bucket_bytes {self.bucket_bytes} (None = per-leaf, "
                 f"0 = one fused buffer, N>0 = ~N-byte buckets)"
             )
+        if self.wire_domain not in ("dequant", "homomorphic"):
+            raise ValueError(
+                f"bad wire_domain {self.wire_domain!r} "
+                f"(dequant | homomorphic)"
+            )
+        if self.wire_domain == "homomorphic":
+            if self.compress in (None, "none"):
+                raise ValueError(
+                    "wire_domain='homomorphic' needs a compress mode "
+                    "(--compress-grad compress|2round): an uncompressed "
+                    "f32 psum has nothing to homomorphically sum"
+                )
+            if self.quant_rounding == "stochastic":
+                raise ValueError(
+                    "wire_domain='homomorphic' needs "
+                    "quant_rounding='nearest': shared scales put every "
+                    "worker on ONE lattice, and the per-worker-seeded "
+                    "stochastic draws (keys fold the worker index by "
+                    "design) have no coherent meaning under the "
+                    "compressed-domain rescale — there is no "
+                    "identically-seeded mode to opt into"
+                )
+            # the exact-accumulation bound: raises past the int32
+            # capacity (ops/quantize.ACCUM_CAPACITY) so overflow is a
+            # config error, never a silent wrap
+            accum_dtype(self.num_workers)
         if self.error_feedback and self.compress in (None, "none"):
             raise ValueError("error_feedback needs a compress mode")
         if self.dynamic_loss_scale:
@@ -648,11 +692,19 @@ def _shard_reduce_bucket(bucket, size: int, axis, n: int, w, k, cfg,
             contrib = dequantize_int8(
                 q.astype(jnp.int32), scale, block_size=bsz, shape=(size,)
             )
+        homomorphic = cfg.wire_domain == "homomorphic"
         if cfg.compress == "int8":
+            # homomorphic: the scatter-sum rides the minimal exact
+            # accumulator (int16 through 258 workers — half the dequant
+            # path's int32 wire); the sums are bit-identical integers
+            acc_dt = accum_dtype(n) if homomorphic else jnp.int32
             sb = lax.psum_scatter(
-                q.reshape(-1).astype(jnp.int32), axis, tiled=True
+                q.reshape(-1).astype(acc_dt), axis, tiled=True
             )
         else:
+            # the sharded 2-round wire is already compressed-domain by
+            # construction (int8 a2a + LOCAL int32 sum, shard-only
+            # dequant) — wire_domain changes nothing here
             q8 = q.reshape(n, s).astype(jnp.int8)
             recv = lax.all_to_all(
                 q8, axis, split_axis=0, concat_axis=0, tiled=True
@@ -661,9 +713,18 @@ def _shard_reduce_bucket(bucket, size: int, axis, n: int, w, k, cfg,
         if bsz:
             nb_loc = s // bsz
             my_scales = lax.dynamic_slice(scale, (w * nb_loc, 0), (nb_loc, 1))
+            if homomorphic:
+                # ONE deferred scale-multiply: the aggregation count
+                # folds into the shard's own scale rows
+                return (
+                    sb.reshape(nb_loc, bsz).astype(jnp.float32)
+                    * (my_scales / k)
+                ).reshape(-1), contrib
             return (
                 sb.reshape(nb_loc, bsz).astype(jnp.float32) * my_scales
             ).reshape(-1) / k, contrib
+        if homomorphic:
+            return dequantize_int8(sb, scale / k), contrib
         return dequantize_int8(sb, scale) / k, contrib
     return lax.psum_scatter(bucket, axis, tiled=True) / k, None
 
@@ -1094,6 +1155,7 @@ def make_ps_train_step(
                 flat_output=is_flat and not bucket_out,
                 pipelined=pipelined,
                 bucket_output=bucket_out,
+                wire_domain=cfg.wire_domain,
             )
             if cfg.error_feedback:
                 # the contribution (and the residual it defines) stays

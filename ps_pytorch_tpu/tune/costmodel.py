@@ -29,7 +29,14 @@ caveat of runs/predicted_scaling.json's alpha-beta pricing, but the
 orderings it produces are pinned against evidence the repo has already
 banked (tests/test_tune.py: per-leaf vs bucketed collective counts from
 runs/comm_contract.json, serial vs pipelined headroom from
-runs/overlap_ab.json).
+runs/overlap_ab.json, and the homomorphic wire ranking <= its dequant
+twin on the ResNet18 int8 leg).
+
+The ``wire_domain`` knob (§6h) needs no special term: a homomorphic
+candidate's narrowed accumulator psum (int16 vs int32), dropped round-2
+scale rows, and int8 hierarchical reassembly all land in its OWN traced
+byte rows, so ``comm_seconds_from_rows`` prices the compressed-domain
+wire exactly the way PSC104 accounts it.
 """
 
 from __future__ import annotations

@@ -85,6 +85,7 @@ class Knobs:
     opt_placement: str = "replicated"   # "replicated" | "sharded"
     quant_block_size: int = 0
     state_layout: str = "flat"
+    wire_domain: str = "dequant"        # "dequant" | "homomorphic"
 
     def bucket_tag(self) -> str:
         bb = self.bucket_bytes
@@ -108,6 +109,7 @@ class Knobs:
             "--opt-placement": self.opt_placement,
             "--quant-block-size": self.quant_block_size,
             "--state-layout": self.state_layout,
+            "--wire-domain": self.wire_domain,
         }
 
     def to_json(self) -> dict:
@@ -154,6 +156,16 @@ def build_grid(model: str, grid: str = "default") -> List[Knobs]:
                          quant_block_size=32))
         out.append(Knobs(compress="int8", bucket_bytes=bucketed,
                          state_layout="tree"))
+        # the wire_domain axis (§6h): the compressed-domain twins of the
+        # quantized points — the model prices the narrowed psum / the
+        # dropped f32 rows straight from the candidates' own traced
+        # accounting
+        out.append(Knobs(compress="int8", bucket_bytes=bucketed,
+                         wire_domain="homomorphic"))
+        out.append(Knobs(compress="int8", bucket_bytes=bucketed,
+                         overlap="pipelined", wire_domain="homomorphic"))
+        out.append(Knobs(compress="int8_2round", bucket_bytes=fused,
+                         wire_domain="homomorphic"))
         return out
     if grid == "smoke":
         for compress in (None, "int8"):
@@ -164,6 +176,8 @@ def build_grid(model: str, grid: str = "default") -> List[Knobs]:
         out.append(Knobs(compress="int8_2round", bucket_bytes=fused,
                          quant_block_size=32))
         out.append(Knobs(compress="int8_2round", bucket_bytes=bucketed))
+        out.append(Knobs(compress="int8", bucket_bytes=fused,
+                         wire_domain="homomorphic"))
         return out
     if grid == "tiny":
         return [
@@ -173,7 +187,11 @@ def build_grid(model: str, grid: str = "default") -> List[Knobs]:
             Knobs(compress="int8", bucket_bytes=bucketed),
             Knobs(compress="int8", bucket_bytes=bucketed,
                   overlap="pipelined"),
+            Knobs(compress="int8", bucket_bytes=bucketed,
+                  wire_domain="homomorphic"),
             Knobs(compress="int8", overlap="pipelined"),    # config-invalid
+            Knobs(compress=None,
+                  wire_domain="homomorphic"),               # config-invalid
             Knobs(compress="int8_2round", bucket_bytes=fused,
                   quant_block_size=32),                     # PSC103-pruned
         ]
@@ -195,6 +213,7 @@ def spec_for(knobs: Knobs, network: str):
         overlap=knobs.overlap,
         bucket_tag=knobs.bucket_tag(),
         quant_block_size=knobs.quant_block_size,
+        wire_domain=knobs.wire_domain,
     )
 
 
@@ -266,6 +285,7 @@ def measure_probe(
         opt_placement=knobs.opt_placement,
         quant_block_size=knobs.quant_block_size,
         state_layout=knobs.state_layout,
+        wire_domain=knobs.wire_domain,
     )
     tx = build_optimizer(
         "sgd", 0.01, momentum=0.9, flat=(knobs.state_layout == "flat")

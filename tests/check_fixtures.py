@@ -22,6 +22,10 @@ shaped so every rule's failure mode exists somewhere runnable:
 - adaptive_fat_wire: declares an adaptive-mask envelope smaller than
                   the gradient psum actually moves — the
                   bytes-per-count regression PSC108 exists for
+- homomorphic_widened: a declared compressed-domain (int16-accumulator)
+                  wire whose gradient psum quietly widened back to
+                  int32 — the payload-widening regression the
+                  homomorphic PSC103 policy exists for (§6h)
 - depipelined:    declares OverlapPolicy(mode="pipelined") over a
                   4-bucket plan but reduces everything in ONE fused
                   psum — the silent re-serialization PSC109 exists for
@@ -327,6 +331,44 @@ def _adaptive_fat_wire() -> ContractSpec:
     )
 
 
+def _homomorphic_widened() -> ContractSpec:
+    L = 4096
+
+    def build() -> Built:
+        mesh = _mesh_1d()
+
+        def f(p, x):
+            loss = jnp.sum(p[:4] * x[0])
+            g = jax.grad(lambda q: jnp.sum(q[:4] * x[0]))(p)
+            q = jnp.clip(g * 127.0, -127, 127).astype(jnp.int8)
+            # BUG: the homomorphic wire's contract is the MINIMAL exact
+            # accumulator (int16 for 8 workers) — widening the psum to
+            # int32 doubles the payload bytes back to the dequant shape
+            s = lax.psum(q.astype(jnp.int32), AXIS)
+            return p - 0.1 * (s.astype(jnp.float32) / (127.0 * N)), \
+                lax.pmean(loss, AXIS)
+
+        step = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P(AXIS)),
+            out_specs=(P(), P()), check_vma=False,
+        ))
+        return _built(step, L)
+
+    return ContractSpec(
+        name="homomorphic_widened", build=build, axes=(AXIS,),
+        grad_reduce=(GradReduce(AXIS, ("psum",)),),
+        wire=WirePolicy(
+            axes=(AXIS,), payload_dtype="int16",
+            allow=(
+                WireAllowance(kind="psum", dtype="float32", max_bytes=64,
+                              reason="metrics pmean"),
+                WireAllowance(kind="pmax", dtype="float32",
+                              max_bytes=4096, reason="scale rows"),
+            ),
+        ),
+    )
+
+
 def _depipelined() -> ContractSpec:
     # a healthy fused step (grad psum feeds params, axis consumed, no
     # donation declared) whose contract CLAIMS a pipelined 4-bucket
@@ -368,6 +410,7 @@ def get_contracts():
         _serve_chatty(),
         _serve_f32_kv(),
         _adaptive_fat_wire(),
+        _homomorphic_widened(),
         _depipelined(),
         _ok_psum(),
     )

@@ -166,6 +166,73 @@ def test_bucket_knobs_tag_metric_and_validate(bench, monkeypatch):
         bench._validate_env()
 
 
+def test_wire_ab_knob_tags_metric_and_validates(bench, monkeypatch):
+    """BENCH_AB_WIRE (§6h): tagged metric key, needs a compressed wire,
+    mutually exclusive with the other A/B dimensions, CNN-only."""
+    monkeypatch.setenv("BENCH_WORKLOAD", "lenet")
+    monkeypatch.delenv("BENCH_COMPRESS", raising=False)
+    base = bench._success_metric()
+    monkeypatch.setenv("BENCH_AB_WIRE", "1")
+    # lenet's canonical wire is uncompressed: nothing to homomorphically
+    # sum, refused with the remedy named
+    with pytest.raises(SystemExit, match="BENCH_COMPRESS"):
+        bench._validate_env()
+    monkeypatch.setenv("BENCH_COMPRESS", "int8")
+    bench._validate_env()
+    assert bench._success_metric() == base + "_int8w_ab_wire"
+    # resnet18's canonical mode is already compressed — no override needed
+    monkeypatch.setenv("BENCH_WORKLOAD", "resnet18")
+    monkeypatch.delenv("BENCH_COMPRESS", raising=False)
+    bench._validate_env()
+    assert bench._success_metric().endswith("_ab_wire")
+    # one A/B dimension per record
+    monkeypatch.setenv("BENCH_AB_OVERLAP", "1")
+    with pytest.raises(SystemExit, match="mutually exclusive"):
+        bench._validate_env()
+    monkeypatch.delenv("BENCH_AB_OVERLAP")
+    # CNN-only, like every other wire knob
+    monkeypatch.setenv("BENCH_WORKLOAD", "lm")
+    with pytest.raises(SystemExit):
+        bench._validate_env()
+    monkeypatch.setenv("BENCH_WORKLOAD", "lenet")
+    monkeypatch.setenv("BENCH_AB_WIRE", "2")
+    with pytest.raises(SystemExit, match="0 or 1"):
+        bench._validate_env()
+    # AB_WIRE=0 is inert (a CI wrapper exporting it globally must not
+    # abort the lm leg)
+    monkeypatch.setenv("BENCH_AB_WIRE", "0")
+    monkeypatch.setenv("BENCH_WORKLOAD", "lm")
+    bench._validate_env()
+
+
+def test_comm_contract_entry_homomorphic_twins(bench):
+    """wire_domain routes the contract lookup to the homomorphic twin
+    entries, and the derived gradient-path bytes show the §6h shrink
+    (int16 psum = half the dequant twin's int32)."""
+    deq = bench._comm_contract_entry("lenet", "int8", None)
+    hom = bench._comm_contract_entry("lenet", "int8", None, "homomorphic")
+    assert hom and hom["config"] == "ps_int8_replicated_homomorphic"
+    assert deq["grad_wire_bytes"] == 2 * hom["grad_wire_bytes"]
+    res = bench._comm_contract_entry(
+        "resnet18", "int8", 4 << 20, "homomorphic"
+    )
+    assert res and res["config"] == (
+        "ps_resnet18_int8_replicated_bucketed_homomorphic"
+    )
+    # the ResNet pair's gradient-path ratio is EXACTLY the int32->int16
+    # payload shrink: the BatchNorm f32 stats psum (model state, not
+    # gradients) must not dilute it
+    res_deq = bench._comm_contract_entry("resnet18", "int8", 4 << 20)
+    assert res_deq["grad_wire_bytes"] == 2 * res["grad_wire_bytes"]
+    # the uncompressed wire's f32 gradient psum still counts as payload
+    none_row = bench._comm_contract_entry("lenet", None, None)
+    assert none_row["grad_wire_bytes"] > 1 << 20
+    # untraced homomorphic combos still yield None, never a mislabel
+    assert bench._comm_contract_entry(
+        "lenet", None, None, "homomorphic"
+    ) is None
+
+
 def test_comm_contract_entry_exact_match_only(bench):
     """The committed pscheck rows attach only when the bench config maps
     onto a traced registry entry — a different bucket carving must yield

@@ -164,7 +164,8 @@ def fixture_contract(tmp_path_factory):
     assert set(data["configs"]) == {
         "dead_axis", "metrics_only", "fat_f32_wire", "drift",
         "undonated", "donate_mismatch", "defused", "serve_chatty",
-        "serve_f32_kv", "adaptive_fat_wire", "depipelined", "ok_psum",
+        "serve_f32_kv", "adaptive_fat_wire", "homomorphic_widened",
+        "depipelined", "ok_psum",
     }
     data["configs"]["drift"]["collectives"][0]["bytes"] += 1
     path.write_text(json.dumps(data))
@@ -184,6 +185,7 @@ def fixture_contract(tmp_path_factory):
         ("serve_chatty", "PSC107"),
         ("serve_f32_kv", "PSC107"),
         ("adaptive_fat_wire", "PSC108"),
+        ("homomorphic_widened", "PSC103"),
         ("depipelined", "PSC109"),
     ],
 )
@@ -277,7 +279,7 @@ def test_check_sh_write_with_contract_value_is_not_refused(tmp_path):
     # rc 1: the broken fixtures trip their rules, but the write happened
     # (no exit-2 refusal from the shell gate)
     assert proc.returncode == 1, proc.stdout + proc.stderr
-    assert "wrote 12 config(s)" in proc.stdout
+    assert "wrote 13 config(s)" in proc.stdout
     assert out.exists()
 
 
@@ -359,6 +361,87 @@ def test_committed_contract_pins_bucketing_collapse():
     # and the fused LeNet variants collapse to exactly one reduce
     for name in ("ps_int8_replicated_bucketed",):
         assert grad_psums(name) == 1, committed["configs"][name]
+
+
+def test_committed_contract_pins_homomorphic_wire_shrink():
+    """The §6h headline in artifact form: the homomorphic twins
+    eliminate the gradient-path f32 widening — the hierarchical ICI
+    reassembly all_gather shrinks f32 -> int8 (~4x), the "int8" psum
+    narrows int32 -> int16 (2x), and the homomorphic 2round gather hop
+    carries NO f32 scale rows at all."""
+    committed = load_contract(str(CONTRACT))
+
+    def rows(name):
+        return committed["configs"][name]["collectives"]
+
+    def one(name, kind, axes, dtype):
+        hits = [
+            r for r in rows(name)
+            if r["kind"] == kind and r["axes"] == axes
+            and r["dtype"] == dtype
+        ]
+        assert len(hits) == 1, (name, kind, axes, dtype, hits)
+        return hits[0]
+
+    # hier reassembly: f32 431080 B -> int8 107770 B, exactly 4x
+    deq = one("ps_hier_int8_2round_replicated_bucketed",
+              "all_gather", ["workers"], "float32")
+    hom = one("ps_hier_int8_2round_replicated_bucketed_homomorphic",
+              "all_gather", ["workers"], "int8")
+    assert deq["bytes"] == 4 * hom["bytes"], (deq, hom)
+    # and the homomorphic hier wire carries ZERO f32 payload rows
+    # (metrics/scale scalars only: every f32 row is tiny)
+    for r in rows("ps_hier_int8_2round_replicated_bucketed_homomorphic"):
+        if r["dtype"] == "float32":
+            assert r["bytes"] <= 64, r
+    # the "int8" psum narrows to the minimal exact accumulator
+    deq = one("ps_int8_replicated", "psum", ["workers"], "int32")
+    hom = one("ps_int8_replicated_homomorphic", "psum", ["workers"],
+              "int16")
+    assert deq["bytes"] == 2 * hom["bytes"], (deq, hom)
+    # the flat 2round gather hop loses its f32 scale-row gather
+    assert any(
+        r["kind"] == "all_gather" and r["dtype"] == "float32"
+        for r in rows("ps_int8_2round_replicated_bucketed")
+    )
+    assert not any(
+        r["kind"] == "all_gather" and r["dtype"] == "float32"
+        for r in rows("ps_int8_2round_replicated_bucketed_homomorphic")
+    )
+
+
+def test_homomorphic_allowance_list_strictly_shrinks():
+    """PSC103's declared allowance list must be STRICTLY SMALLER for
+    homomorphic configs than for their dequant twins — the widening
+    permissions (round-2 scale gather, hier f32 reassembly) stop
+    existing rather than merely going unused — and the homomorphic
+    "int8" scheme gains a wire policy its dequant twin cannot have."""
+    from ps_pytorch_tpu.check.contracts import _ps_spec
+
+    pairs = [
+        dict(compress="int8_2round", placement="replicated",
+             bucket_bytes=0),
+        dict(compress="int8_2round", placement="replicated", dcn_hosts=2,
+             bucket_bytes=0),
+        dict(compress="int8_2round", placement="sharded"),
+    ]
+    for kw in pairs:
+        kw = dict(kw)
+        placement = kw.pop("placement")
+        compress = kw.pop("compress")
+        deq = _ps_spec(compress, placement, **kw)
+        hom = _ps_spec(compress, placement, wire_domain="homomorphic",
+                       **kw)
+        assert deq.wire is not None and hom.wire is not None
+        assert set(hom.wire.allow) < set(deq.wire.allow), (
+            deq.name, hom.name,
+        )
+    # new coverage: the dequant int8 scheme declares NO wire policy
+    # (int32 psum by design); the homomorphic twin declares one with the
+    # narrow accumulator as payload
+    assert _ps_spec("int8", "replicated").wire is None
+    hom = _ps_spec("int8", "replicated", wire_domain="homomorphic")
+    assert hom.wire is not None and hom.wire.payload_dtype == "int16"
 
 
 def test_committed_contract_pins_a_silent_serving_wire():

@@ -477,6 +477,326 @@ def test_ef_checkpoint_into_non_ef_target_errors(mesh, tmp_path):
         load_checkpoint(target, str(tmp_path), 3)
 
 
+# ------------------------------------------- homomorphic wire (§6h)
+
+
+def test_accum_dtype_pins_the_overflow_bound():
+    """The no-overflow contract of the compressed-domain sum: int16
+    holds exactly 258 full-scale int8 payloads (259 * 127 > 32767),
+    int32 exactly 16_909_320, and past that accum_dtype refuses rather
+    than wraps — so PSConfig(wire_domain='homomorphic') can never build
+    a mesh whose worst-case sum overflows its wire dtype."""
+    from ps_pytorch_tpu.ops.quantize import ACCUM_CAPACITY, accum_dtype
+
+    assert accum_dtype(1) == jnp.int16
+    assert accum_dtype(8) == jnp.int16
+    assert accum_dtype(ACCUM_CAPACITY["int16"]) == jnp.int16
+    assert accum_dtype(ACCUM_CAPACITY["int16"] + 1) == jnp.int32
+    assert accum_dtype(ACCUM_CAPACITY["int32"]) == jnp.int32
+    with pytest.raises(ValueError, match="overflow"):
+        accum_dtype(ACCUM_CAPACITY["int32"] + 1)
+    with pytest.raises(ValueError, match=">= 1"):
+        accum_dtype(0)
+    # the bounds really are the worst-case sums, checked in numpy's own
+    # integer arithmetic
+    assert ACCUM_CAPACITY["int16"] * 127 <= np.iinfo(np.int16).max
+    assert (ACCUM_CAPACITY["int16"] + 1) * 127 > np.iinfo(np.int16).max
+    assert ACCUM_CAPACITY["int32"] * 127 <= np.iinfo(np.int32).max
+    assert (ACCUM_CAPACITY["int32"] + 1) * 127 > np.iinfo(np.int32).max
+    # a concrete full-scale accumulation at the int16 capacity is exact
+    worst = np.full((ACCUM_CAPACITY["int16"],), 127, np.int16)
+    assert int(worst.astype(np.int64).sum()) == int(
+        np.add.reduce(worst, dtype=np.int16)
+    )
+
+
+@pytest.mark.parametrize("block", [0, 128], ids=["per_tensor", "per_block"])
+def test_homomorphic_shared_scales_identical_on_every_worker(mesh, block):
+    """The shared-scale rule: ONE max-abs reduction gives every worker
+    the same scale row set, so one set serves all workers and the int
+    payload sum is a sum on one lattice."""
+    from ps_pytorch_tpu.ops.quantize import quantize_int8
+
+    x = jnp.asarray(np.random.RandomState(3).randn(257).astype(np.float32))
+
+    def body(t):
+        w = jax.lax.axis_index(WORKER_AXIS)
+        local = jnp.roll(t, w)  # distinct payloads, same value multiset
+        _, scale = quantize_int8(
+            local, axis_name=WORKER_AXIS, block_size=block
+        )
+        return scale.reshape(1, -1)
+
+    stacked = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=(P(),),
+            out_specs=P(WORKER_AXIS), check_vma=False,
+        )
+    )(x)
+    stacked = np.asarray(stacked)  # [N, n_rows]
+    assert stacked.shape[0] == N
+    for w in range(1, N):
+        np.testing.assert_array_equal(stacked[0], stacked[w])
+
+
+def test_homomorphic_accum_bit_exact_vs_dequantize_then_sum(mesh):
+    """THE §6h numerical pin: the homomorphic integer accumulation is
+    bit-exact against summing the same dequantized payloads. The test
+    data's absmax is 127 * 2^-3, so the shared scale is a power of two:
+    per-worker dequantization (q * s) is then EXACT in f32, the f32 sum
+    of dequantized payloads equals s * (sum of ints) exactly, and the
+    deferred single multiply must match it bitwise. The integer psum is
+    additionally recovered and compared as integers."""
+    from ps_pytorch_tpu.ops.quantize import dequantize_int8, quantize_int8
+
+    rng = np.random.RandomState(5)
+    x = (rng.randint(-127, 128, (257,)).astype(np.float32)) * (2.0 ** -3)
+    x[0] = 127.0 * 2.0 ** -3  # pin absmax -> scale is exactly 2^-3
+    x = jnp.asarray(x)
+
+    def body(t):
+        w = jax.lax.axis_index(WORKER_AXIS)
+        local = jnp.roll(t, w)  # same multiset -> same shared scale
+        hom = quantized_psum(
+            [local], WORKER_AXIS, float(N),
+            wire_domain="homomorphic", num_workers=N,
+        )[0]
+        q, scale = quantize_int8(local, axis_name=WORKER_AXIS)
+        int_sum = jax.lax.psum(q.astype(jnp.int32), WORKER_AXIS)
+        deq_then_sum = jax.lax.psum(
+            dequantize_int8(q.astype(jnp.int32), scale), WORKER_AXIS
+        )
+        return hom, int_sum, deq_then_sum, scale
+
+    hom, int_sum, deq_then_sum, scale = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=(P(),), out_specs=P(),
+            check_vma=False,
+        )
+    )(x)
+    s = float(scale)
+    assert s == 2.0 ** -3  # the power-of-two premise really holds
+    # bitwise: deferred-single-multiply == dequantize-then-sum (/ N is
+    # exact: N is a power of two)
+    np.testing.assert_array_equal(
+        np.asarray(hom), np.asarray(deq_then_sum) / N
+    )
+    # and the integer accumulation is exactly the sum of the payloads
+    recovered = np.asarray(hom) * (N / s)
+    np.testing.assert_array_equal(recovered, np.asarray(int_sum))
+
+
+@pytest.mark.parametrize("block", [0, 128], ids=["per_tensor", "per_block"])
+def test_homomorphic_2round_close_to_exact_mean(mesh, block):
+    """The homomorphic 2-round wire stays within the quant-spec
+    envelope of the exact mean: round 1's shared-scale quantization
+    (error <= s/2 per worker) plus ONE lattice rescale (error <= s/2) —
+    the same order as the dequant twin's round-2 requantization."""
+    tree = _tree(6)
+    got = _run_collective(
+        mesh,
+        lambda t: quantized_allreduce_2round(
+            t, WORKER_AXIS, float(N), N, block_size=block,
+            wire_domain="homomorphic",
+        ),
+        tree,
+    )
+    want = _run_collective(
+        mesh, lambda t: psum_mean(t, WORKER_AXIS, float(N)), tree
+    )
+    for g, w, orig in zip(got, want, tree):
+        bound = 2.5 * float(jnp.max(jnp.abs(orig))) * 1.7 / 127.0
+        err = float(jnp.max(jnp.abs(g - w)))
+        assert err <= bound, (err, bound)
+
+
+def test_homomorphic_hier_close_to_exact_mean():
+    """The hierarchical homomorphic wire (globally-shared scales, int8
+    on every hop incl. the ICI reassembly) stays within the declared
+    envelope of the exact mean and agrees on every chip."""
+    from ps_pytorch_tpu.parallel import make_hybrid_mesh
+    from ps_pytorch_tpu.parallel.collectives import (
+        quantized_allreduce_2round_hier,
+    )
+
+    hmesh = make_hybrid_mesh(num_hosts=2, per_host=4)
+    tree = _tree(8, shapes=((57, 5), (301,)))
+
+    def body(t):
+        d = jax.lax.axis_index(DCN_AXIS).astype(jnp.float32)
+        w = jax.lax.axis_index(WORKER_AXIS).astype(jnp.float32)
+        local = jax.tree.map(lambda g: g * (1.0 + 0.05 * (4 * d + w)), t)
+        got = quantized_allreduce_2round_hier(
+            local, (DCN_AXIS, WORKER_AXIS), float(N), (2, 4),
+            wire_domain="homomorphic",
+        )
+        want = psum_mean(local, (DCN_AXIS, WORKER_AXIS), float(N))
+        return got, want
+
+    got, want = jax.jit(
+        jax.shard_map(
+            body, mesh=hmesh, in_specs=(P(),), out_specs=P(),
+            check_vma=False,
+        )
+    )(tree)
+    for g, w, orig in zip(got, want, tree):
+        # round 1 (s/2) + two lattice rescales (s/2 each): <= 3 lattice
+        # steps of the shared scale, loosely bounded via the data
+        bound = 3.5 * float(jnp.max(jnp.abs(orig))) * 1.5 / 127.0
+        err = float(jnp.max(jnp.abs(g - w)))
+        assert err <= bound, (err, bound)
+
+
+@pytest.mark.parametrize(
+    "extra",
+    [
+        dict(compress="int8", quant_block_size=128, error_feedback=True),
+        dict(compress="int8_2round", quant_block_size=128,
+             error_feedback=True),
+        dict(compress="int8", opt_placement="sharded",
+             quant_block_size=128, error_feedback=True),
+        dict(compress="int8", quant_block_size=128, error_feedback=True,
+             bucket_bytes=64 << 10, overlap="pipelined"),
+    ],
+    ids=["int8_ef", "2round_ef", "zero1_int8_ef", "int8_ef_pipelined"],
+)
+def test_homomorphic_e2e_training_parity_vs_dequant(mesh, extra):
+    """End-to-end training parity (§6h acceptance): the homomorphic
+    wire trains within the declared quant-spec envelope of the dequant
+    wire — same seeds, same batches, EF absorbing the (coarser)
+    shared-scale error exactly as it does on the dequant wire. The
+    one-STEP update is pinned to the envelope (the two wires round
+    differently, so multi-step trajectories drift apart chaotically —
+    the same reason the dequant wire is only envelope-close to the
+    uncompressed psum); the 6-step trajectory is pinned to train and
+    land near the dequant loss."""
+    results = {}
+    for domain in ("dequant", "homomorphic"):
+        cfg = PSConfig(num_workers=N, wire_domain=domain, **extra)
+        state, step, batch = _tiny_setup(mesh, cfg, seed=6)
+        losses = []
+        p1 = None
+        for i in range(6):
+            state, m = step(state, batch, jax.random.key(i))
+            if i == 0:
+                p1 = jax.device_get(tree_view(state.params))
+            losses.append(float(m["loss"]))
+        results[domain] = (losses, p1)
+    ld, pd = results["dequant"]
+    lh, ph = results["homomorphic"]
+    assert all(np.isfinite(lh)), lh
+    assert lh[-1] < lh[0], lh  # the homomorphic wire really trains
+    # one-step parity envelope vs the dequant wire
+    for a, b in zip(jax.tree_util.tree_leaves(pd),
+                    jax.tree_util.tree_leaves(ph)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0.1, atol=5e-3
+        )
+    assert abs(lh[-1] - ld[-1]) < 0.2 * (1.0 + abs(ld[-1])), (lh, ld)
+
+
+def test_homomorphic_hier_e2e_training_parity(mesh):
+    """The hierarchical DCN x ICI homomorphic wire trains in parity
+    with its dequant twin (serial; the hier wire has no pipelined
+    registry twin — §6g covers pipelined x homomorphic on the flat
+    schemes). Same one-step-envelope / multi-step-trajectory split as
+    the flat-scheme parity test."""
+    from ps_pytorch_tpu.parallel import make_hybrid_mesh
+
+    hmesh = make_hybrid_mesh(num_hosts=2, per_host=4)
+    results = {}
+    for domain in ("dequant", "homomorphic"):
+        cfg = PSConfig(num_workers=N, dcn_hosts=2, compress="int8_2round",
+                       quant_block_size=128, error_feedback=True,
+                       wire_domain=domain)
+        state, step, batch = _tiny_setup(hmesh, cfg, seed=3)
+        losses = []
+        p1 = None
+        for i in range(6):
+            state, m = step(state, batch, jax.random.key(i))
+            if i == 0:
+                p1 = jax.device_get(tree_view(state.params))
+            losses.append(float(m["loss"]))
+        results[domain] = (losses, p1)
+    ld, pd = results["dequant"]
+    lh, ph = results["homomorphic"]
+    assert all(np.isfinite(lh)) and lh[-1] < lh[0], lh
+    for a, b in zip(jax.tree_util.tree_leaves(pd),
+                    jax.tree_util.tree_leaves(ph)):
+        np.testing.assert_allclose(
+            np.asarray(a), np.asarray(b), rtol=0.1, atol=5e-3
+        )
+    assert abs(lh[-1] - ld[-1]) < 0.2 * (1.0 + abs(ld[-1])), (lh, ld)
+
+
+def test_homomorphic_sharded_2round_wire_is_unchanged(mesh):
+    """In the ZeRO-1 placement the 2-round wire is ALREADY
+    compressed-domain (int8 a2a + local int32 sum + shard-only
+    dequant), so wire_domain='homomorphic' must be a VALUE no-op there:
+    bit-identical training to the dequant spelling."""
+    results = {}
+    for domain in ("dequant", "homomorphic"):
+        cfg = PSConfig(num_workers=N, opt_placement="sharded",
+                       compress="int8_2round", quant_block_size=128,
+                       wire_domain=domain)
+        state, step, batch = _tiny_setup(mesh, cfg, seed=5)
+        for i in range(3):
+            state, m = step(state, batch, jax.random.key(i))
+        results[domain] = (jax.device_get(state.params), float(m["loss"]))
+    assert results["dequant"][1] == results["homomorphic"][1]
+    for a, b in zip(
+        jax.tree_util.tree_leaves(results["dequant"][0]),
+        jax.tree_util.tree_leaves(results["homomorphic"][0]),
+    ):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_homomorphic_config_validation():
+    """Both parse-time rejections the §6h satellites pin, plus the
+    accumulator-capacity bound and the CLI flag mapping."""
+    import argparse
+
+    from ps_pytorch_tpu.cli._flags import (
+        add_ps_flags,
+        add_train_flags,
+        ps_config_from,
+    )
+    from ps_pytorch_tpu.ops.quantize import ACCUM_CAPACITY
+
+    with pytest.raises(ValueError, match="nothing to homomorphically"):
+        PSConfig(num_workers=4, wire_domain="homomorphic")
+    with pytest.raises(ValueError, match="nearest"):
+        PSConfig(num_workers=4, compress="int8",
+                 quant_rounding="stochastic", wire_domain="homomorphic")
+    with pytest.raises(ValueError, match="bad wire_domain"):
+        PSConfig(num_workers=4, compress="int8", wire_domain="int8")
+    with pytest.raises(ValueError, match="overflow"):
+        PSConfig(num_workers=ACCUM_CAPACITY["int32"] + 1,
+                 compress="int8", wire_domain="homomorphic")
+    # the CLI flag maps onto the config (and defaults to dequant)
+    parser = argparse.ArgumentParser()
+    add_train_flags(parser)
+    add_ps_flags(parser)
+    args = parser.parse_args(
+        ["--wire-domain", "homomorphic", "--compress-grad", "compress"]
+    )
+    assert ps_config_from(args, 8).wire_domain == "homomorphic"
+    assert ps_config_from(parser.parse_args([]), 8).wire_domain == "dequant"
+    # the two rejections surface through the CLI mapping too
+    with pytest.raises(ValueError, match="nothing to homomorphically"):
+        ps_config_from(
+            parser.parse_args(["--wire-domain", "homomorphic"]), 8
+        )
+    with pytest.raises(ValueError, match="nearest"):
+        ps_config_from(
+            parser.parse_args(
+                ["--wire-domain", "homomorphic", "--compress-grad",
+                 "compress", "--quant-rounding", "stochastic"]
+            ),
+            8,
+        )
+
+
 def test_config_validation():
     with pytest.raises(ValueError, match="needs a compress"):
         PSConfig(num_workers=4, error_feedback=True)

@@ -318,9 +318,23 @@ _HEAVY = pytest.mark.slow
             marks=_HEAVY,
         ),
         dict(num_aggregate=3, mask_mode="first_k", bucket_bytes=4096),
+        # the homomorphic wire (§6h) under the pipelined stream: the
+        # compressed-domain sum is per-bucket too (shared scales fold
+        # per piece; the lattice rescale is deterministic), so the
+        # schedule stays a pure reorder — bit-exact like every other
+        # nearest-rounding combo
+        dict(compress="int8", quant_block_size=64, error_feedback=True,
+             bucket_bytes=4096, wire_domain="homomorphic"),
+        pytest.param(
+            dict(compress="int8_2round", quant_block_size=32,
+                 bucket_bytes=8192, error_feedback=True,
+                 wire_domain="homomorphic"),
+            marks=_HEAVY,
+        ),
     ],
     ids=["none_flat", "int8_ef", "2round", "zero1_int8_ef", "tree_int8",
-         "int8_stochastic", "static_mask"],
+         "int8_stochastic", "static_mask", "int8_homomorphic",
+         "2round_homomorphic_ef"],
 )
 def test_pipelined_bit_exact_vs_serial(mesh, extra):
     """The flagship pin: same config, both schedules, bit-identical

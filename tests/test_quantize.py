@@ -99,6 +99,54 @@ def test_pallas_kernels_in_interpret_mode(monkeypatch):
     np.testing.assert_allclose(np.asarray(sb_ref), np.asarray(sb_pl))
 
 
+def test_accum_rescale_pallas_matches_jnp_in_interpret_mode(monkeypatch):
+    """The fused homomorphic accumulate+rescale kernel (§6h stretch):
+    the Pallas path (interpret mode on CPU, like the flash kernels)
+    must be bit-identical to the pure-jnp spelling — same exact int32
+    sum, same f32 divide, same round-half-even, same clip."""
+    from ps_pytorch_tpu.ops import quantize as qz
+
+    rng = np.random.RandomState(11)
+    # 8 worker rows of full-range int8, s % 128 == 0 so the kernel path
+    # engages; include the +/-127 extremes so the clip edge is exercised
+    recv = rng.randint(-127, 128, (8, 512)).astype(np.int8)
+    recv[0, :2] = [127, -127]
+    recv = jnp.asarray(recv)
+
+    monkeypatch.delenv("PS_TPU_PALLAS_INTERPRET", raising=False)
+    monkeypatch.setenv("PS_TPU_DISABLE_PALLAS", "1")
+    ref = qz.accumulate_rescale_int8(recv, 8.0)
+    monkeypatch.delenv("PS_TPU_DISABLE_PALLAS", raising=False)
+    monkeypatch.setenv("PS_TPU_PALLAS_INTERPRET", "1")
+    pl_out = qz.accumulate_rescale_int8(recv, 8.0)
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(pl_out))
+    assert pl_out.dtype == jnp.int8
+    # unaligned widths fall back to jnp even with pallas enabled
+    ragged = jnp.asarray(rng.randint(-127, 128, (8, 130)).astype(np.int8))
+    out = qz.accumulate_rescale_int8(ragged, 8.0)
+    np.testing.assert_array_equal(
+        np.asarray(out),
+        np.asarray(qz.homomorphic_rescale(
+            jnp.sum(ragged.astype(jnp.int32), axis=0), 8.0
+        )),
+    )
+    # a traced divisor (the adaptive aggregation count) works through
+    # the kernel's SMEM scalar operand
+    traced = jax.jit(qz.accumulate_rescale_int8)(recv, jnp.float32(8.0))
+    np.testing.assert_array_equal(np.asarray(ref), np.asarray(traced))
+
+
+def test_homomorphic_rescale_bounds():
+    """|acc| <= divisor * 127 implies the rescaled value provably fits
+    int8 — including at the exact extremes."""
+    from ps_pytorch_tpu.ops.quantize import homomorphic_rescale
+
+    acc = jnp.asarray([8 * 127, -8 * 127, 0, 4, -4], jnp.int32)
+    out = np.asarray(homomorphic_rescale(acc, 8.0))
+    np.testing.assert_array_equal(out, [127, -127, 0, 0, 0])
+    assert out.dtype == np.int8
+
+
 def test_stochastic_rounding_unbiased():
     import numpy as np
 

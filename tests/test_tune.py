@@ -160,6 +160,26 @@ def test_model_agrees_with_banked_overlap_ab():
     assert pip["mean_dispatch_prefix"] < ser["mean_dispatch_prefix"]
 
 
+def test_model_ranks_homomorphic_wire_at_or_under_dequant():
+    """The §6h satellite pin: on the ResNet18 int8 leg the model must
+    rank the homomorphic wire <= its dequant twin. The committed
+    contract pins the mechanism — the gradient psum narrows int32 ->
+    int16 (half the bytes, same rows otherwise) — so the comm term is
+    strictly cheaper through the same pricing the PSC104 artifact rows
+    get."""
+    cfgs = json.loads(CONTRACT.read_text())["configs"]
+    pairs = (
+        ("ps_resnet18_int8_replicated_bucketed",
+         "ps_resnet18_int8_replicated_bucketed_homomorphic"),
+        ("ps_int8_replicated", "ps_int8_replicated_homomorphic"),
+    )
+    for deq_name, hom_name in pairs:
+        deq, hom = cfgs[deq_name], cfgs[hom_name]
+        t_deq = comm_seconds_from_rows(deq["collectives"], AXIS8, PROFILE)
+        t_hom = comm_seconds_from_rows(hom["collectives"], AXIS8, PROFILE)
+        assert t_hom < t_deq, (deq_name, t_hom, t_deq)
+
+
 # -------------------------------------- committed record: the gate
 
 @pytest.fixture(scope="module")
@@ -260,9 +280,12 @@ def test_search_tiny_grid_prunes_and_ranks(tiny_search):
     rec = tiny_search
     validate_event(dict(rec))
     validate_event(dict(rec["run"]))
-    assert rec["n_candidates"] == 5
+    assert rec["n_candidates"] == 6
     stages = {p["stage"] for p in rec["pruned"]}
     assert stages == {"config", "contract"}
+    # both engine-refused points (pipelined per-leaf wire, homomorphic
+    # uncompressed wire) prune at the config stage
+    assert len([p for p in rec["pruned"] if p["stage"] == "config"]) == 2
     (contract,) = [p for p in rec["pruned"] if p["stage"] == "contract"]
     assert contract["rules"] == ["PSC103"]
     assert contract["reason"]  # the finding text rides along as evidence

@@ -5,7 +5,9 @@
 # parallelism axes), the PS CNN trainer + evaluator, the elasticity
 # drill (SIGTERM on 8 workers -> resume-reshape on 4 with an adaptive
 # mask under a straggler storm), the flat-state
-# default (int8 + EF + guard NaN-inject), the LM trainer on tp with
+# default (int8 + EF + guard NaN-inject), the homomorphic
+# compressed-domain wire (2round int8 + EF + 64 KiB buckets + pipelined
+# overlap + NaN-inject), the LM trainer on tp with
 # vocab-parallel embedding + the LM evaluator with KV-cache sampling,
 # the serving engine under open-loop traffic with one hot checkpoint
 # rollover, the observability leg (traced train + serve merged into one
@@ -109,6 +111,33 @@ run python -m ps_pytorch_tpu.cli.train \
     --error-feedback --bucket-bytes 65536 \
     --fault-plan '{"nan_grads":[3]}' \
     --train-dir "$TMP/flat"
+
+# homomorphic-wire leg (ARCHITECTURE §6h, --wire-domain homomorphic):
+# the bandwidth-honest 2-round int8 wire summed in the COMPRESSED
+# domain (shared scales, integer accumulation, one deferred
+# scale-multiply per bucket), stacked with error feedback, 64 KiB
+# buckets, and the pipelined schedule — and a NaN gradient at step 3
+# proving the non-finite guard still fires on the homomorphic wire
+# (the guard reduces the RAW gradients, upstream of the lattice)
+run python -m ps_pytorch_tpu.cli.train \
+    --network LeNet --dataset MNIST --num-workers 8 --batch-size 64 \
+    --max-steps 6 --eval-freq 3 --log-interval 1 \
+    --compress-grad 2round --quant-block-size 32 --error-feedback \
+    --bucket-bytes 65536 --overlap on --wire-domain homomorphic \
+    --fault-plan '{"nan_grads":[3]}' \
+    --metrics-file "$TMP/homomorphic/metrics.jsonl" \
+    --train-dir "$TMP/homomorphic"
+run python - "$TMP/homomorphic/metrics.jsonl" <<'PYEOF'
+import json, math, sys
+events = [json.loads(l) for l in open(sys.argv[1])]
+skips = [e for e in events if e.get("kind") == "grad_skip"]
+assert skips and skips[0]["skipped_steps"] >= 1, skips
+trains = [e for e in events if e.get("kind") == "train"]
+assert trains and math.isfinite(trains[-1]["loss"]), trains
+print("homomorphic smoke: guard skipped %d step(s) on the int8 "
+      "compressed-domain wire, final loss %.3f"
+      % (skips[-1]["skipped_steps"], trains[-1]["loss"]))
+PYEOF
 
 run python -m ps_pytorch_tpu.cli.train_lm \
     --parallelism tp --heads 8 --dim 64 --vocab-size 64 --shard-vocab \
