@@ -11,9 +11,11 @@ donation survives lowering (PSC105), bucketed wires stay fused — no
 more gradient-path collectives than the declared bucket plan allows
 (PSC106) — the serving hot path stays collective-free with an
 honest KV storage dtype (PSC107), and adaptive-mask configs keep their
-grad-reduce declaration and byte envelope (PSC108), and pipelined
+grad-reduce declaration and byte envelope (PSC108), pipelined
 configs move exactly their serial twin's bytes with a real per-bucket
-dispatch (PSC109).
+dispatch (PSC109), and adaptive configs name a real host-consensus
+point for their traced count — checked against pslint's consensus
+inventory (PSC110, the static half of PSL007's divergence guarantee).
 
 Entry points: ``python -m ps_pytorch_tpu.check``, ``tools/check.sh``,
 and the tier-1 gate in tests/test_check.py.

@@ -59,7 +59,7 @@ def main(argv=None) -> int:
 
     parser = argparse.ArgumentParser(
         prog="python -m ps_pytorch_tpu.check",
-        description="jaxpr-level contract checker (rules PSC101-PSC106).",
+        description="jaxpr-level contract checker (rules PSC101-PSC110).",
     )
     parser.add_argument("--format", choices=("text", "json"),
                         default="text")

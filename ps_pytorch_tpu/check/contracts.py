@@ -127,11 +127,21 @@ class AdaptivePolicy:
     gradients are non-zero, what the denominator is), never bytes — a
     traced count that started moving per-count payloads (e.g. a gather
     of the mask, or a resize of the wire) is a regression this pin
-    catches."""
+    catches.
+
+    PSC110: ``consensus`` names the host-consensus point that agrees the
+    traced count across processes before it is fed to the step — a
+    package-relative dotted path (``trainer.Trainer._count_consensus``)
+    that must exist in pslint's consensus inventory (a function whose
+    returned value passes through broadcast_one_to_all/process_allgather,
+    see lint/diverge.py). An adaptive config with no declared consensus
+    point is PR 7's per-host agg_count bug waiting to recur: each host
+    adapts on its own timing and the traced counts tear."""
 
     min_aggregate: int
     max_aggregate: int
     envelope_bytes: int
+    consensus: Optional[str] = None
 
 
 @dataclasses.dataclass(frozen=True)
@@ -504,6 +514,9 @@ def _ps_spec(
             min_aggregate=cfg.num_aggregate_min,
             max_aggregate=cfg.num_aggregate_max,
             envelope_bytes=plan.padded_total * 4,
+            # the host controller's proposal is min-reduced across
+            # processes before the traced count changes (PSC110)
+            consensus="trainer.Trainer._count_consensus",
         )
 
     overlap_policy = None

@@ -192,12 +192,18 @@ def run_checks(
 ) -> List[CheckFinding]:
     """Run every rule over traced results; `contract` is the committed
     artifact (None skips PSC104 — used by --write-contract)."""
-    from .rules import check_result, psc104_roundtrip, psc109_schedule
+    from .rules import (
+        check_result,
+        psc104_roundtrip,
+        psc109_schedule,
+        psc110_consensus,
+    )
 
     findings: List[CheckFinding] = []
     for r in results:
         findings.extend(check_result(r))
     findings.extend(psc109_schedule(results))
+    findings.extend(psc110_consensus(results))
     if contract is not None:
         findings.extend(psc104_roundtrip(results, contract,
                                          check_stale=check_stale))

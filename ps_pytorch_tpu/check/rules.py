@@ -45,6 +45,14 @@
 |        | collectives each feeding the updated params, so the PSC102      |
 |        | dataflow guarantee holds PER BUCKET — a "pipelined" config      |
 |        | whose wire quietly re-fused into one barrier eqn fails          |
+| PSC110 | undeclared host-consensus for adaptive configs: a config        |
+|        | declaring an AdaptivePolicy must NAME the host-consensus point  |
+|        | (``AdaptivePolicy.consensus``, a package-relative dotted path)  |
+|        | that agrees the traced count across processes, and that name    |
+|        | must resolve in pslint's consensus inventory (lint/diverge.py:  |
+|        | a function whose return passes through broadcast_one_to_all /   |
+|        | process_allgather) — an adaptive knob with no consensus point   |
+|        | is PR 7's per-host agg_count tear waiting to recur              |
 """
 
 from __future__ import annotations
@@ -55,7 +63,7 @@ from .core import CheckFinding, TraceResult
 from .walker import REDUCE_KINDS
 
 RULE_IDS = ("PSC101", "PSC102", "PSC103", "PSC104", "PSC105", "PSC106",
-            "PSC107", "PSC108", "PSC109")
+            "PSC107", "PSC108", "PSC109", "PSC110")
 
 
 def psc101_axes(r: TraceResult) -> List[CheckFinding]:
@@ -301,6 +309,52 @@ def psc109_schedule(results: Sequence[TraceResult]) -> List[CheckFinding]:
                 f"serial twin '{twin.spec.name}' moves {theirs} B — "
                 f"pipelining must reorder the schedule, never change "
                 f"the bytes",
+            ))
+    return out
+
+
+def psc110_consensus(results: Sequence[TraceResult]) -> List[CheckFinding]:
+    """Adaptive configs must declare a REAL host-consensus point.
+
+    The traced aggregation count is a jitted-step input that must be
+    bit-identical on every process (a torn count = different masked
+    reduces = divergent replicated params, PR 7's bug). The dynamic half
+    of that guarantee is pslint's PSL007; this is the static registry
+    half: every AdaptivePolicy names where consensus happens, and the
+    name must resolve to a consensus-shaped function (its return value
+    passes through broadcast_one_to_all/process_allgather) in the
+    package — found by the same AST walker the divergence lint uses
+    (lint/diverge.py:consensus_inventory), so a renamed or de-consensused
+    helper breaks this gate, not a pod run."""
+    from ..lint.diverge import consensus_inventory
+
+    out: List[CheckFinding] = []
+    inventory = None
+    for r in results:
+        ad = r.spec.adaptive
+        if ad is None:
+            continue
+        if not ad.consensus:
+            out.append(CheckFinding(
+                "PSC110", r.spec.name,
+                "AdaptivePolicy declares a traced aggregation count but "
+                "no host-consensus point (AdaptivePolicy.consensus) — "
+                "each process would adapt on its own timing and feed the "
+                "step torn counts; name the function that agrees the "
+                "count (e.g. 'trainer.Trainer._count_consensus')",
+            ))
+            continue
+        if inventory is None:
+            inventory = consensus_inventory()
+        if ad.consensus not in inventory:
+            known = ", ".join(sorted(inventory)) or "none found"
+            out.append(CheckFinding(
+                "PSC110", r.spec.name,
+                f"declared host-consensus point '{ad.consensus}' is not "
+                f"in the package's consensus inventory (functions whose "
+                f"return passes through broadcast_one_to_all/"
+                f"process_allgather; known: {known}) — renamed, or no "
+                f"longer consensus-shaped",
             ))
     return out
 

@@ -143,7 +143,13 @@ def save_checkpoint(state, model_dir: str, step: int, compress: bool = False,
     other processes (e.g. --resume after preemption) requires
     `model_dir` to be on storage all hosts share — a gcsfuse bucket
     (tools/tpu_cluster.py mount) or NFS, exactly like the reference's
-    NFS train_dir (README.md:23)."""
+    NFS train_dir (README.md:23).
+
+    This hold-then-broadcast shape is the sanctioned error idiom psdiverge
+    (PSL006, ARCHITECTURE §7b) checks against: raising inside the
+    ``process_index() == 0`` branch BEFORE the barrier is exactly the
+    stranded-collective bug this function once shipped, and is now a
+    regression fixture in tests/test_lint.py."""
     host_state = _gather_host_state(state)
     path = checkpoint_path(model_dir, step)
     err = None

@@ -38,20 +38,29 @@ EVAL_SEQUENCE_SEED_OFFSET = 7919  # prime shift: held-out walks, same chain
 _listify = listify_raw
 
 
+def _fwd_dense(cfg, params, tokens):
+    from ..models.transformer import apply_transformer
+
+    return apply_transformer(cfg, params, tokens)
+
+
+def _fwd_moe(cfg, moe, params, tokens):
+    from ..parallel.moe import apply_moe_transformer
+
+    return apply_moe_transformer(cfg, moe, params, tokens, None)[0]
+
+
 @functools.lru_cache(maxsize=8)
 def _cached_fwd(cfg, moe):
     """One compiled forward per (model config, moe config) — the polling
     loop evaluates many checkpoints of the same run and must not re-trace
-    (a fresh jit(lambda) per checkpoint recompiles every poll)."""
-    from ..models.transformer import apply_transformer
-
+    (a fresh jit per checkpoint recompiles every poll). Module-level defs
+    partial-bound per config, not jit(lambda): the lru_cache already pins
+    one compiled callable per config, and PSL002 can verify a named def
+    where a lambda would need a baseline entry."""
     if moe is not None:
-        from ..parallel.moe import apply_moe_transformer
-
-        return jax.jit(
-            lambda p, t: apply_moe_transformer(cfg, moe, p, t, None)[0]
-        )
-    return jax.jit(lambda p, t: apply_transformer(cfg, p, t))
+        return jax.jit(functools.partial(_fwd_moe, cfg, moe))
+    return jax.jit(functools.partial(_fwd_dense, cfg))
 
 
 def evaluate_checkpoint(model_dir: str, step: int, eval_size: int = 64,

@@ -20,6 +20,21 @@ rules, each with a stable ID:
   PSL005  donated-buffer reuse: reading a variable after it was passed in
           a ``donate_argnums`` position (invalid buffer on TPU; CPU only
           warns, so tests pass locally and crash on the pod).
+  PSL006  divergent-collective guard: process-divergent host state
+          (process_index, clocks, RNG, fs listings, env vars, caught
+          exceptions) guards a branch/loop that runs a collective on one
+          path but not the other, or raises out from under divergent
+          control while a later collective still expects this process.
+  PSL007  divergent traced value: a process-divergent value flows into a
+          traced step call, checkpoint restore, shared artifact, or run
+          identity that must be bit-identical on every host.
+  PSL008  divergent collective order: both paths of a tainted branch run
+          collectives, but in different orders — cross-matched rendezvous.
+
+PSL006-PSL008 (the psdiverge pass, diverge.py) only analyze modules that
+reference the multihost machinery; ``jax.process_count()`` compares are
+deployment constants, and ``broadcast_one_to_all``/``process_allgather``
+launder taint, so the blessed rank-0-then-broadcast idiom never fires.
 
 Usage:
     python -m ps_pytorch_tpu.lint [paths] [--format json] \
@@ -28,7 +43,8 @@ Usage:
 Suppression: ``# psl: ignore`` (all rules on that line),
 ``# psl: ignore[PSL001,PSL004]`` (specific rules), ``# psl: sync-ok``
 (alias for ignore[PSL004]), ``# psl: donate-ok`` (alias for
-ignore[PSL005]). Legacy findings live in a checked-in baseline
+ignore[PSL005]), ``# psl: diverge-ok`` (alias for
+ignore[PSL006,PSL007,PSL008]). Legacy findings live in a checked-in baseline
 (``lint_baseline.json``) so they don't block CI; new findings fail tier-1
 via tests/test_lint.py.
 """

@@ -29,7 +29,7 @@ DEFAULT_BASELINE = "lint_baseline.json"
 def main(argv=None) -> int:
     parser = argparse.ArgumentParser(
         prog="python -m ps_pytorch_tpu.lint",
-        description="JAX/TPU-aware static analysis (rules PSL001-PSL005).",
+        description="JAX/TPU-aware static analysis (rules PSL001-PSL008).",
     )
     parser.add_argument("paths", nargs="*", default=["ps_pytorch_tpu"],
                         help="files or directories to lint "

@@ -30,6 +30,7 @@ _PRAGMA_ALIASES = {
     "ignore": None,
     "sync-ok": ("PSL004",),
     "donate-ok": ("PSL005",),
+    "diverge-ok": ("PSL006", "PSL007", "PSL008"),
 }
 
 

@@ -1,4 +1,6 @@
-"""The five pslint rules. Pure-AST: no jax import, no code execution.
+"""The pslint rules (PSL001-PSL008). Pure-AST: no jax import, no code
+execution. PSL006-PSL008 (SPMD-divergence taint analysis) live in
+diverge.py and are registered here.
 
 Each rule is a class with `rule_id` and `check(tree, path, axes, donors)`
 yielding (lineno, col, message) tuples. Shared helpers keep name
@@ -798,11 +800,22 @@ class DonationReuseRule:
                 yield from self._process_exprs([stmt], step_vars, consumed)
 
 
+# Imported at the bottom so diverge.py can reuse this module's helpers
+# (STEP_CALL_RE, _dotted, _tail) without a circular import at load time.
+from .diverge import (  # noqa: E402
+    DivergentGuardRule,
+    DivergentOrderRule,
+    DivergentTracedRule,
+)
+
 RULES = [
     MeshAxisRule(),
     RecompilationRule(),
     TracedPurityRule(),
     HostSyncRule(),
     DonationReuseRule(),
+    DivergentGuardRule(),
+    DivergentTracedRule(),
+    DivergentOrderRule(),
 ]
 RULE_IDS = tuple(r.rule_id for r in RULES)

@@ -157,7 +157,15 @@ def initialize_multihost(
     backend". This jaxlib ships the gloo TCP collectives, so a
     multi-process job that is explicitly pinned to CPU flips them on
     before the backend is created. Must run before anything touches
-    ``jax.devices()`` (backend creation reads the flag once)."""
+    ``jax.devices()`` (backend creation reads the flag once).
+
+    SPMD contract: every process runs this with the same effective
+    arguments, and everything downstream (mesh construction, the train
+    loop's collectives) assumes bit-identical control flow across hosts.
+    Host code in this module is in psdiverge's scope — guards derived
+    from per-process values around collective ops are flagged as PSL006
+    (ARCHITECTURE §7b); the env-var gate above stays clean because it
+    guards only process-local jax.config writes, never a collective."""
     if coordinator_address is None:
         return
     import os

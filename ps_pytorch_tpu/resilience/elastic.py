@@ -550,7 +550,15 @@ class AdaptiveMaskController:
     straggler seen by ANY host shrinks everyone; recovery happens only
     when every host's window was clean. The ``slow_steps`` field of the
     mask_adapt event stays the LOCAL observation (hosts' events may
-    differ there; step/from/to are identical by construction)."""
+    differ there; step/from/to are identical by construction).
+
+    This consensus hookup is CONTRACT, not convention: the registry's
+    adaptive specs declare it as ``AdaptivePolicy.consensus =
+    "trainer.Trainer._count_consensus"`` and PSC110 statically verifies
+    the named function exists and is consensus-shaped (its return passes
+    through a consensus collective — lint/diverge.py's inventory), while
+    PSL007 flags any new path that feeds a process-divergent count to
+    the traced step without laundering it first."""
 
     def __init__(self, cfg, threshold_s: float, window: int,
                  event_sink=None, consensus=None):

@@ -83,6 +83,22 @@ def append_metrics_line(path: Optional[str], record: dict) -> None:
         f.write(json.dumps(record) + "\n")
 
 
+def _shared_run_id() -> str:
+    """One run id for ALL processes of a multihost run.
+
+    ``new_run_id()`` is per-process RNG, so each host would stamp its
+    metrics run header and span-trace file with a DIFFERENT id, breaking
+    the cross-process correlation tools/trace_report.py merges on
+    (PSL007). Process 0's draw is broadcast as bytes so every host
+    carries the same id."""
+    rid = np.frombuffer(new_run_id().encode("ascii"), dtype=np.uint8)
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        rid = multihost_utils.broadcast_one_to_all(rid)
+    return np.asarray(rid).tobytes().decode("ascii")
+
+
 def average_metrics(step_fn, batches) -> dict:
     """Uniform average of per-batch metric dicts (batches are equal-sized:
     BatchIterator drops partial tails). Shared by Trainer.validate and the
@@ -268,8 +284,9 @@ class Trainer:
             faults=self.faults,
         )
         # one run id ties this run's streams together (metrics JSONL run
-        # header + the per-process span trace file)
-        self.run_id = new_run_id()
+        # header + the per-process span trace file) — broadcast from
+        # process 0 so every host agrees on it
+        self.run_id = _shared_run_id()
         self.tracer = NULL_TRACER
         if tcfg.trace_dir:
             self.tracer = Tracer(

@@ -319,14 +319,32 @@ def _serve_f32_kv() -> ContractSpec:
 def _adaptive_fat_wire() -> ContractSpec:
     # a perfectly healthy psum step (PSC101/102/105 clean, no donation
     # declared) whose AdaptivePolicy envelope is smaller than the 8-leaf
-    # f32 psum's 32 B — only the PSC108 byte pin can trip
+    # f32 psum's 32 B — only the PSC108 byte pin can trip (the consensus
+    # declaration is valid, so PSC110 stays quiet)
     return ContractSpec(
         name="adaptive_fat_wire",
         build=lambda: _built(_clean_step(donate=False), 8),
         axes=(AXIS,),
         grad_reduce=(GradReduce(AXIS, ("psum",)),),
         adaptive=AdaptivePolicy(
-            min_aggregate=2, max_aggregate=N, envelope_bytes=16
+            min_aggregate=2, max_aggregate=N, envelope_bytes=16,
+            consensus="trainer.Trainer._count_consensus",
+        ),
+    )
+
+
+def _adaptive_no_consensus() -> ContractSpec:
+    # BUG fixture: a healthy adaptive psum step (envelope fits the 8-leaf
+    # f32 psum's 32 B, so PSC108 stays quiet) that declares NO host
+    # consensus point for its traced count — PR 7's per-host agg_count
+    # shape at the registry level; only PSC110 can trip
+    return ContractSpec(
+        name="adaptive_no_consensus",
+        build=lambda: _built(_clean_step(donate=False), 8),
+        axes=(AXIS,),
+        grad_reduce=(GradReduce(AXIS, ("psum",)),),
+        adaptive=AdaptivePolicy(
+            min_aggregate=2, max_aggregate=N, envelope_bytes=64
         ),
     )
 
@@ -410,6 +428,7 @@ def get_contracts():
         _serve_chatty(),
         _serve_f32_kv(),
         _adaptive_fat_wire(),
+        _adaptive_no_consensus(),
         _homomorphic_widened(),
         _depipelined(),
         _ok_psum(),

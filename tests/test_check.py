@@ -164,8 +164,8 @@ def fixture_contract(tmp_path_factory):
     assert set(data["configs"]) == {
         "dead_axis", "metrics_only", "fat_f32_wire", "drift",
         "undonated", "donate_mismatch", "defused", "serve_chatty",
-        "serve_f32_kv", "adaptive_fat_wire", "homomorphic_widened",
-        "depipelined", "ok_psum",
+        "serve_f32_kv", "adaptive_fat_wire", "adaptive_no_consensus",
+        "homomorphic_widened", "depipelined", "ok_psum",
     }
     data["configs"]["drift"]["collectives"][0]["bytes"] += 1
     path.write_text(json.dumps(data))
@@ -185,6 +185,7 @@ def fixture_contract(tmp_path_factory):
         ("serve_chatty", "PSC107"),
         ("serve_f32_kv", "PSC107"),
         ("adaptive_fat_wire", "PSC108"),
+        ("adaptive_no_consensus", "PSC110"),
         ("homomorphic_widened", "PSC103"),
         ("depipelined", "PSC109"),
     ],
@@ -279,7 +280,7 @@ def test_check_sh_write_with_contract_value_is_not_refused(tmp_path):
     # rc 1: the broken fixtures trip their rules, but the write happened
     # (no exit-2 refusal from the shell gate)
     assert proc.returncode == 1, proc.stdout + proc.stderr
-    assert "wrote 13 config(s)" in proc.stdout
+    assert "wrote 14 config(s)" in proc.stdout
     assert out.exists()
 
 

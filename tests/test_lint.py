@@ -691,3 +691,315 @@ def test_cli_exit_zero_on_package(tmp_path):
         capture_output=True, text=True, cwd=str(REPO),
     )
     assert proc.returncode == 0, proc.stdout + proc.stderr
+
+
+# ------------------------------------------- PSL006-PSL008 (psdiverge)
+#
+# The three historical multihost bugs, reproduced verbatim as fixtures.
+# Each must trip EXACTLY its intended rule; the blessed
+# rank-0-then-broadcast idiom and count-gated single-process tails must
+# stay silent.
+
+# PR 3's save_checkpoint: rank 0's write fails and raises BEFORE the
+# barrier every other process is already waiting at — ranks 1..N-1 hang
+# forever. (The fixed shape holds the error, reaches the collectives,
+# and re-raises after; see checkpoint.save_checkpoint.)
+PR3_STRANDED_SAVE = """
+import jax
+from jax.experimental import multihost_utils
+
+def save_checkpoint(path, state, step):
+    if jax.process_index() == 0:
+        try:
+            _write(path, state)
+        except OSError as e:
+            raise CheckpointWriteError(path) from e
+    multihost_utils.sync_global_devices(f"ckpt_save_{step}")
+"""
+
+# PR 7's torn-replica resume: every host walks its OWN directory listing
+# and restores whatever IT sees newest — a file torn on some replicas of
+# a shared dir sends hosts down different fallbacks, and jax never
+# cross-checks replicated values.
+PR7_TORN_RESUME = """
+import jax
+import ps_pytorch_tpu.checkpoint as ckpt
+
+def try_resume(target, train_dir):
+    pid = jax.process_index()
+    steps = ckpt.available_steps(train_dir)
+    for step in reversed(steps):
+        try:
+            return ckpt.load_checkpoint(target, train_dir, step)
+        except OSError:
+            continue
+    return None
+"""
+
+# PR 7's per-host agg_count: a wall-clock heuristic adapts the
+# aggregation count locally and feeds it straight into the traced step —
+# torn counts mean different masked reduces and silently divergent
+# replicated params.
+PR7_LOCAL_AGG_COUNT = """
+import time
+import jax
+import numpy as np
+
+def train(state, batches, train_step, threshold):
+    if jax.process_count() == 1:
+        return state
+    count = 1
+    for batch in batches:
+        t0 = time.perf_counter()
+        state, metrics = train_step(state, batch, np.int32(count))
+        if time.perf_counter() - t0 > threshold:
+            count = count + 1
+    return state
+"""
+
+PSL008_CROSSED_ORDER = """
+import os
+import jax
+from jax.experimental import multihost_utils
+
+def reconcile(path, a, b):
+    if os.path.getmtime(path) > 100.0:
+        a = multihost_utils.process_allgather(a)
+        b = multihost_utils.broadcast_one_to_all(b)
+    else:
+        b = multihost_utils.broadcast_one_to_all(b)
+        a = multihost_utils.process_allgather(a)
+    return a, b
+"""
+
+# Asymmetric guard: an env-var branch runs the barrier on one path only.
+PSL006_ASYMMETRIC_GUARD = """
+import os
+import jax
+from jax.experimental import multihost_utils
+
+def maybe_sync(step):
+    if os.environ.get("PS_EAGER_SYNC"):
+        multihost_utils.sync_global_devices(f"s_{step}")
+"""
+
+# Divergent loop: per-host listing decides how many times each process
+# rendezvouses.
+PSL006_DIVERGENT_LOOP = """
+import os
+import jax
+from jax.experimental import multihost_utils
+
+def sweep(d, x):
+    for name in os.listdir(d):
+        x = multihost_utils.process_allgather(x)
+    return x
+"""
+
+
+@pytest.mark.parametrize(
+    "src,rule",
+    [
+        (PR3_STRANDED_SAVE, "PSL006"),
+        (PR7_TORN_RESUME, "PSL007"),
+        (PR7_LOCAL_AGG_COUNT, "PSL007"),
+        (PSL008_CROSSED_ORDER, "PSL008"),
+        (PSL006_ASYMMETRIC_GUARD, "PSL006"),
+        (PSL006_DIVERGENT_LOOP, "PSL006"),
+    ],
+    ids=["pr3-stranded-save", "pr7-torn-resume", "pr7-local-agg-count",
+         "psl008-crossed-order", "asymmetric-guard", "divergent-loop"],
+)
+def test_divergence_fixture_trips_exactly_its_rule(src, rule):
+    findings = _lint(src)
+    assert sorted({f.rule for f in findings}) == [rule], [
+        (f.rule, f.line, f.message) for f in findings
+    ]
+
+
+# The blessed idiom: process 0 walks per-process state, the choice is
+# broadcast, every process acts on the SAME laundered value
+# (trainer._try_resume_multihost's shape).
+BLESSED_RANK0_BROADCAST = """
+import jax
+import numpy as np
+import ps_pytorch_tpu.checkpoint as ckpt
+from jax.experimental import multihost_utils
+
+def resume(target, train_dir):
+    chosen = -1
+    if jax.process_index() == 0:
+        for step in reversed(ckpt.available_steps(train_dir)):
+            chosen = step
+            break
+    chosen = int(multihost_utils.broadcast_one_to_all(np.int32(chosen)))
+    if chosen < 0:
+        return None
+    return ckpt.load_checkpoint(target, train_dir, chosen)
+"""
+
+# Barrier-rejoined branches: divergent control with NO collectives inside
+# either path, rejoined at a barrier every process reaches.
+BLESSED_BARRIER_REJOIN = """
+import jax
+from jax.experimental import multihost_utils
+
+def log_and_sync(step):
+    if jax.process_index() == 0:
+        _write_summary(step)
+    else:
+        _noop(step)
+    multihost_utils.sync_global_devices(f"joined_{step}")
+"""
+
+# The FIXED PR 3 shape: hold the error, reach every collective, re-raise
+# after — raises happen outside divergent control.
+BLESSED_HELD_ERROR_SAVE = """
+import jax
+import numpy as np
+from jax.experimental import multihost_utils
+
+def save_checkpoint(path, state, step):
+    err = None
+    if jax.process_index() == 0:
+        try:
+            _write(path, state)
+        except OSError as e:
+            err = e
+    ok = int(multihost_utils.broadcast_one_to_all(
+        np.int32(0 if err is not None else 1)))
+    multihost_utils.sync_global_devices(f"ckpt_save_{step}")
+    if not ok:
+        raise CheckpointWriteError(path)
+"""
+
+# A count-gate early return makes the remainder single-process: per-host
+# listings feeding restores are fine when there is only one host.
+BLESSED_SINGLE_PROCESS_TAIL = """
+import jax
+import ps_pytorch_tpu.checkpoint as ckpt
+
+def try_resume(target, train_dir):
+    steps = ckpt.available_steps(train_dir)
+    if jax.process_count() > 1:
+        return _multihost_resume(target, steps)
+    for step in reversed(steps):
+        return ckpt.load_checkpoint(target, train_dir, step)
+    return None
+
+def _multihost_resume(target, steps):
+    return None
+"""
+
+# Mesh-consensus restore through a module-local helper: the laundered
+# choice flows through _restore_step into the real restore calls
+# (trainer.py's exact call chain).
+BLESSED_RESTORE_HELPER = """
+import jax
+import numpy as np
+import ps_pytorch_tpu.checkpoint as ckpt
+from jax.experimental import multihost_utils
+
+def _restore_step(target, train_dir, step):
+    raw = ckpt.load_checkpoint_raw(train_dir, step)
+    return ckpt.restore_from_raw(target, raw, step)
+
+def resume(target, train_dir):
+    chosen = -1
+    if jax.process_index() == 0:
+        steps = ckpt.available_steps(train_dir)
+        if steps:
+            chosen = steps[-1]
+    chosen = int(multihost_utils.broadcast_one_to_all(np.int32(chosen)))
+    if chosen < 0:
+        return None
+    return _restore_step(target, train_dir, chosen)
+"""
+
+
+@pytest.mark.parametrize(
+    "src",
+    [
+        BLESSED_RANK0_BROADCAST,
+        BLESSED_BARRIER_REJOIN,
+        BLESSED_HELD_ERROR_SAVE,
+        BLESSED_SINGLE_PROCESS_TAIL,
+        BLESSED_RESTORE_HELPER,
+    ],
+    ids=["rank0-broadcast", "barrier-rejoin", "held-error-save",
+         "single-process-tail", "restore-helper"],
+)
+def test_sanctioned_multihost_idiom_is_clean(src):
+    assert _lint(src) == []
+
+
+def test_divergence_skips_modules_without_multihost_markers():
+    # same sink shape as PR7_LOCAL_AGG_COUNT, but the module never touches
+    # process_index/process_count/multihost_utils: nothing to strand
+    src = """
+import time
+import numpy as np
+
+def train(state, batches, train_step):
+    count = 1
+    for batch in batches:
+        t0 = time.perf_counter()
+        state, _ = train_step(state, batch, np.int32(count))
+        if time.perf_counter() - t0 > 0.5:
+            count = count + 1
+    return state
+"""
+    assert _lint(src) == []
+
+
+def test_diverge_ok_pragma_suppresses():
+    src = PSL006_ASYMMETRIC_GUARD.replace(
+        'if os.environ.get("PS_EAGER_SYNC"):',
+        'if os.environ.get("PS_EAGER_SYNC"):  # psl: diverge-ok',
+    )
+    assert _lint(src) == []
+
+
+def test_rule_scoped_ignore_covers_psl007():
+    src = PR7_TORN_RESUME.replace(
+        "return ckpt.load_checkpoint(target, train_dir, step)",
+        "return ckpt.load_checkpoint(target, train_dir, step)"
+        "  # psl: ignore[PSL007]",
+    )
+    assert _lint(src) == []
+
+
+def test_baseline_is_empty():
+    """The committed baseline carries NO legacy findings: every rule
+    (including PSL006-008) gates the repo at zero. A finding that
+    belongs in the baseline belongs fixed instead."""
+    baseline = json.loads((REPO / "lint_baseline.json").read_text())
+    assert baseline["findings"] == []
+
+
+def test_divergence_gate_is_clean_repo_wide():
+    """Tier-1 gate for the psdiverge pass: PSL006-008 over the package,
+    tools/, and tests/ produce zero findings — multihost control flow
+    stays inside the blessed idiom (or carries a justified pragma)."""
+    findings = lint_paths([
+        str(REPO / "ps_pytorch_tpu"), str(REPO / "tools"),
+        str(REPO / "tests"),
+    ])
+    diverge = [
+        f for f in findings if f.rule in ("PSL006", "PSL007", "PSL008")
+    ]
+    assert diverge == [], "\n".join(
+        f"{f.path}:{f.line}: {f.rule} {f.message}" for f in diverge
+    )
+
+
+def test_consensus_inventory_finds_the_declared_points():
+    """PSC110's static half: the walker must see the trainer's consensus
+    helpers (a consensus collective whose result is returned), and must
+    NOT include functions that never rendezvous."""
+    from ps_pytorch_tpu.lint.diverge import consensus_inventory
+
+    inv = consensus_inventory()
+    assert "trainer.Trainer._count_consensus" in inv
+    assert "trainer.Trainer._stop_consensus" in inv
+    assert "trainer.Trainer.train" not in inv

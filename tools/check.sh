@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # pscheck entry point: jaxpr-level contract checking of the parallel
-# schemes (rules PSC101-PSC106) against runs/comm_contract.json.
+# schemes (rules PSC101-PSC110) against runs/comm_contract.json.
 #
 #   tools/check.sh                   # gate: trace the registry, verify all
 #                                    # contracts + the committed accounting

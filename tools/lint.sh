@@ -20,6 +20,9 @@ source tools/_gate_common.sh
 # at runtime (donation is a warning on CPU, a crash on TPU). tools/,
 # analysis/, and bench.py are gated because their host loops drive the
 # TPU (PSL002 recompilation and PSL004 sync hazards live there too).
+# The psdiverge pass (PSL006-008, multihost divergence) rides the same
+# gate; run it alone with `tools/lint.sh --select PSL006,PSL007,PSL008`
+# (smoke.sh's first leg).
 GATE_PATHS=(ps_pytorch_tpu tests tools analysis bench.py)
 
 REFUSE="tools/lint.sh: --write-baseline always refreshes over the gate's

@@ -33,9 +33,13 @@ run() {
 TMP=$(mktemp -d)
 trap 'rm -rf "$TMP"' EXIT
 
-# static analysis first: cheapest signal, fails fastest. lint.sh reads
-# only source text; check.sh traces the real step functions on the same
-# scrubbed 8-device CPU environment the rest of the smoke uses.
+# static analysis first: cheapest signal, fails fastest. The psdiverge
+# pass (PSL006-008, multihost deadlock/torn-replica hazards) runs as its
+# own leg so a divergence regression is named before the general gate;
+# lint.sh reads only source text; check.sh traces the real step
+# functions on the same scrubbed 8-device CPU environment the rest of
+# the smoke uses.
+run bash tools/lint.sh --select PSL006,PSL007,PSL008
 run bash tools/lint.sh
 run bash tools/check.sh
 
