@@ -17,6 +17,13 @@ dispatch (PSC109), and adaptive configs name a real host-consensus
 point for their traced count — checked against pslint's consensus
 inventory (PSC110, the static half of PSL007's divergence guarantee).
 
+PSC111-114 are the psnumerics rules (check/numerics.py): a precision-
+flow analysis over the same traced jaxpr proves the quantized wire's
+numerics — scale provenance (PSC111), error-feedback closure (PSC112),
+integer-accumulation capacity from the traced axis sizes (PSC113), and
+no silent downcast on the update path (PSC114). They run for every spec
+declaring a NumericsPolicy; rule subsets via ``--select PSC1xx,...``.
+
 Entry points: ``python -m ps_pytorch_tpu.check``, ``tools/check.sh``,
 and the tier-1 gate in tests/test_check.py.
 """
@@ -28,12 +35,15 @@ from .contracts import (
     DonationSpec,
     FusionSpec,
     GradReduce,
+    NarrowingAllowance,
+    NumericsPolicy,
     OverlapPolicy,
     ServePolicy,
     WireAllowance,
     WirePolicy,
     get_contracts,
 )
+from .numerics import NumericsReport, analyze_numerics
 from .core import (
     CheckFinding,
     TraceResult,
@@ -57,12 +67,16 @@ __all__ = [
     "DonationSpec",
     "FusionSpec",
     "GradReduce",
+    "NarrowingAllowance",
+    "NumericsPolicy",
+    "NumericsReport",
     "OverlapPolicy",
     "RULE_IDS",
     "ServePolicy",
     "TraceResult",
     "WireAllowance",
     "WirePolicy",
+    "analyze_numerics",
     "collect_collectives",
     "compiled_op_count",
     "get_contracts",

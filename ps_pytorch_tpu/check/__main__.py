@@ -59,7 +59,7 @@ def main(argv=None) -> int:
 
     parser = argparse.ArgumentParser(
         prog="python -m ps_pytorch_tpu.check",
-        description="jaxpr-level contract checker (rules PSC101-PSC110).",
+        description="jaxpr-level contract checker (rules PSC101-PSC114).",
     )
     parser.add_argument("--format", choices=("text", "json"),
                         default="text")
@@ -77,6 +77,9 @@ def main(argv=None) -> int:
     parser.add_argument("--only", default=None,
                         help="comma-separated config names to trace "
                              "(PSC104 stale-entry checking is skipped)")
+    parser.add_argument("--select", default=None,
+                        help="comma-separated rule ids to enable "
+                             "(default: all)")
     parser.add_argument("--list", action="store_true",
                         help="list registry config names and exit")
     args = parser.parse_args(argv)
@@ -88,6 +91,26 @@ def main(argv=None) -> int:
             file=sys.stderr,
         )
         return 2
+
+    if args.write_contract and args.select:
+        print(
+            "pscheck: --write-contract cannot be combined with --select "
+            "(a re-baseline must clear every rule, not a subset)",
+            file=sys.stderr,
+        )
+        return 2
+
+    selected = None
+    if args.select:
+        from .rules import RULE_IDS
+
+        selected = {r.strip().upper() for r in args.select.split(",")
+                    if r.strip()}
+        unknown = selected - set(RULE_IDS)
+        if unknown:
+            print(f"unknown rule id(s): {', '.join(sorted(unknown))}",
+                  file=sys.stderr)
+            return 2
 
     try:
         specs = _load_registry(args.registry)
@@ -149,6 +172,8 @@ def main(argv=None) -> int:
                   file=sys.stderr)
             return 2
     findings = run_checks(results, contract, check_stale=only is None)
+    if selected is not None:
+        findings = [f for f in findings if f.rule in selected]
 
     if args.format == "json":
         print(json.dumps(

@@ -182,6 +182,48 @@ class ServePolicy:
     kv_dtype: str = "float32"
 
 
+@dataclasses.dataclass(frozen=True)
+class NarrowingAllowance:
+    """One tolerated precision-narrowing convert on the update path
+    (PSC114): src/dst dtype names plus the reason it is sound."""
+
+    src: str
+    dst: str
+    reason: str = ""
+
+
+@dataclasses.dataclass(frozen=True)
+class NumericsPolicy:
+    """PSC111-114: the precision-flow contract (check/numerics.py).
+
+    Declaring a policy turns the numerics rules on for the config:
+    every dequantize's scale must share a max-abs-reduction root with
+    its quantize's (PSC111), the error-feedback residual must close —
+    computed, fed to the carry, never double-counted (PSC112, only when
+    ``error_feedback`` is declared), every integer accumulation on the
+    quantized lattice must provably fit its traced dtype — worst-case
+    |sum| from the TRACED axis sizes, not the config-time
+    ACCUM_CAPACITY table (PSC113), and every precision-narrowing
+    convert downstream of the gradient reduce on the update path must
+    be a detected quantization site or a declared allowance (PSC114).
+
+    ``quantized``: the gradient wire carries a quantized lattice — the
+    trace must contain at least one rooted quantization site on the
+    gradient path, and every integer reduce-kind collective feeding the
+    params needs a PROVEN peak (an unbounded int wire sum on a declared
+    quantized wire is a finding, not a pass).
+    ``accum_dtype``: the declared integer accumulator for the lattice
+    sums ("int16"/"int32"); a traced lattice reduction in any OTHER
+    dtype is a finding — the static half of PR 12's widened-payload
+    regression, caught from dataflow instead of wire bytes.
+    """
+
+    quantized: bool = False
+    error_feedback: bool = False
+    accum_dtype: Optional[str] = None
+    allow_narrowing: Tuple[NarrowingAllowance, ...] = ()
+
+
 @dataclasses.dataclass
 class Built:
     """What a spec's builder returns: the real jitted step plus abstract
@@ -204,6 +246,7 @@ class ContractSpec:
     serve: Optional[ServePolicy] = None
     adaptive: Optional[AdaptivePolicy] = None
     overlap: Optional[OverlapPolicy] = None
+    numerics: Optional[NumericsPolicy] = None
 
 
 # metrics / loss pmean: a handful of f32 scalars, every scheme emits it
@@ -524,6 +567,25 @@ def _ps_spec(
         overlap_policy = OverlapPolicy(mode="pipelined",
                                        serial_twin=serial_twin)
 
+    # the precision-flow contract (PSC111-114): which integer
+    # accumulator the quantized lattice sums into, per wire scheme —
+    # quantized_psum widens int8 -> int32 (homomorphic: the minimal
+    # exact accumulator, int16 on the registry mesh); both 2round
+    # schemes sum their all_to_all'd slices in local int32
+    if compress == "int8" and homomorphic:
+        import jax.numpy as jnp
+
+        from ..ops.quantize import accum_dtype
+
+        num = NumericsPolicy(
+            quantized=True,
+            accum_dtype=jnp.dtype(accum_dtype(MESH_DEVICES)).name,
+        )
+    elif compress in ("int8", "int8_2round"):
+        num = NumericsPolicy(quantized=True, accum_dtype="int32")
+    else:
+        num = NumericsPolicy(quantized=False)
+
     return ContractSpec(
         name=name,
         build=build,
@@ -534,6 +596,7 @@ def _ps_spec(
         fusion=fusion,
         adaptive=adaptive_policy,
         overlap=overlap_policy,
+        numerics=num,
     )
 
 
@@ -579,6 +642,7 @@ def _dp_tp_spec() -> ContractSpec:
             GradReduce(TP_AXIS, ("psum",)),
         ),
         donation=DonationSpec(argnums=(0, 1), out_positions=(0, 1)),
+        numerics=NumericsPolicy(),
     )
 
 
@@ -616,6 +680,7 @@ def _pp_spec() -> ContractSpec:
         axes=(PP_AXIS,),
         grad_reduce=(GradReduce(PP_AXIS, ("psum",)),),
         donation=DonationSpec(argnums=(0, 1), out_positions=(0, 1)),
+        numerics=NumericsPolicy(),
     )
 
 
@@ -654,6 +719,7 @@ def _moe_spec() -> ContractSpec:
         axes=(EP_AXIS,),
         grad_reduce=(GradReduce(EP_AXIS, ("psum",)),),
         donation=DonationSpec(argnums=(0, 1), out_positions=(0, 1)),
+        numerics=NumericsPolicy(),
     )
 
 
@@ -700,6 +766,7 @@ def _dp_tp_pp_spec() -> ContractSpec:
             GradReduce(TP_AXIS, ("psum",)),
         ),
         donation=DonationSpec(argnums=(0, 1), out_positions=(0, 1)),
+        numerics=NumericsPolicy(),
     )
 
 
@@ -757,6 +824,7 @@ def _serve_spec(int8_kv: bool) -> ContractSpec:
         axes=(),  # slot-parallel: NO mesh axis may be consumed
         donation=DonationSpec(argnums=(1,), out_positions=(0,)),
         serve=ServePolicy(kv_argnum=1, quantized=int8_kv),
+        numerics=NumericsPolicy(quantized=int8_kv),
     )
 
 

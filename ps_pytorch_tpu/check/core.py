@@ -51,6 +51,10 @@ class TraceResult:
     )                                 # (path, dtype) of the KV pool arg,
                                       # collected when spec.serve is set
                                       # (PSC107 storage-dtype policy)
+    numerics: Any = None              # NumericsReport (check/numerics.py)
+                                      # — the precision-flow record the
+                                      # PSC111-114 rules read, computed
+                                      # whenever spec.numerics is set
     closed: Any = None                # the traced ClosedJaxpr, retained
                                       # only when trace_spec(keep_jaxpr=
                                       # True) — the tune/ cost model
@@ -131,6 +135,11 @@ def trace_spec(spec: ContractSpec, keep_jaxpr: bool = False) -> TraceResult:
             (jax.tree_util.keystr(path), str(leaf.dtype))
             for path, leaf in flat_kv
         ]
+    numerics = None
+    if spec.numerics is not None:
+        from .numerics import analyze_numerics
+
+        numerics = analyze_numerics(closed, param_out_indices=param_idx)
     return TraceResult(
         spec=spec,
         collectives=colls,
@@ -139,6 +148,7 @@ def trace_spec(spec: ContractSpec, keep_jaxpr: bool = False) -> TraceResult:
         donated_leaves=donated,
         donation_mismatches=mismatches,
         kv_leaves=kv_leaves,
+        numerics=numerics,
         closed=closed if keep_jaxpr else None,
     )
 

@@ -30,6 +30,30 @@ shaped so every rule's failure mode exists somewhere runnable:
                   4-bucket plan but reduces everything in ONE fused
                   psum — the silent re-serialization PSC109 exists for
 - ok_psum:        fully clean (the negative control)
+
+psnumerics fixtures (check/numerics.py precision-flow analysis):
+
+- numerics_fresh_scale: dequantizes the summed lattice with a scale
+                  recomputed from the RECEIVER's data instead of the
+                  max-abs reduction behind the quantize — the scale-
+                  provenance mismatch PSC111 exists for
+- numerics_dropped_residual: declares error_feedback but never computes
+                  the grad - dequant(quant) residual — EF-SGD silently
+                  degraded to biased quantized SGD (PSC112)
+- numerics_widened_accum: PR 12's historical regression as a numerics
+                  fixture — int32 creeping back onto a declared-int16
+                  homomorphic wire, with NO WirePolicy declared, so only
+                  the traced-lattice dtype pin (PSC113) can catch it
+- numerics_scan_opaque: lattice payload accumulated through a scan
+                  carry before the psum — the bound widens to unknown
+                  and PSC113 must say "cannot prove", never pass
+                  vacuously inside a loop body
+- numerics_silent_downcast: the update path drops f32 -> bf16 -> f32
+                  after the gradient reduce with no quantize site and
+                  no declared allowance (PSC114)
+- numerics_ef_closed: a fully-closed error-feedback loop (residual
+                  computed from the SAME dequant and carried out) — the
+                  numerics negative control, passes every rule
 """
 
 from __future__ import annotations
@@ -49,6 +73,7 @@ from ps_pytorch_tpu.check import (
     DonationSpec,
     FusionSpec,
     GradReduce,
+    NumericsPolicy,
     OverlapPolicy,
     ServePolicy,
     WireAllowance,
@@ -406,6 +431,205 @@ def _depipelined() -> ContractSpec:
     )
 
 
+_NUM_INT32 = NumericsPolicy(quantized=True, accum_dtype="int32")
+
+
+def _numerics_fresh_scale() -> ContractSpec:
+    L = 32
+
+    def build() -> Built:
+        mesh = _mesh_1d()
+
+        def f(p, x):
+            loss = jnp.sum(p[:4] * x[0])
+            g = jax.grad(lambda q: jnp.sum(q[:4] * x[0]))(p)
+            scale = jnp.max(jnp.abs(g)) / 127.0
+            q = jnp.clip(g / scale, -127, 127).astype(jnp.int8)
+            s = lax.psum(q.astype(jnp.int32), AXIS)
+            # BUG: the receiver recomputes the dynamic range from its
+            # OWN data — a scale with no dataflow tie to the max-abs
+            # reduction that scaled the quantize
+            wrong = jnp.max(jnp.abs(x[0])) / 127.0
+            deq = s.astype(jnp.float32) * wrong
+            return p - 0.1 * deq, lax.pmean(loss, AXIS)
+
+        step = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P(AXIS)),
+            out_specs=(P(), P()), check_vma=False,
+        ))
+        return _built(step, L)
+
+    return ContractSpec(
+        name="numerics_fresh_scale", build=build, axes=(AXIS,),
+        grad_reduce=(GradReduce(AXIS, ("psum",)),),
+        numerics=_NUM_INT32,
+    )
+
+
+def _numerics_dropped_residual() -> ContractSpec:
+    L = 32
+
+    def build() -> Built:
+        mesh = _mesh_1d()
+
+        def f(p, x):
+            loss = jnp.sum(p[:4] * x[0])
+            g = jax.grad(lambda q: jnp.sum(q[:4] * x[0]))(p)
+            scale = jnp.max(jnp.abs(g)) / 127.0
+            q = jnp.clip(g / scale, -127, 127).astype(jnp.int8)
+            s = lax.psum(q.astype(jnp.int32), AXIS)
+            deq = s.astype(jnp.float32) * (scale / N)
+            # BUG: error_feedback is declared, but g - dequant(q) is
+            # never computed or carried — the residual is dropped
+            return p - 0.1 * deq, lax.pmean(loss, AXIS)
+
+        step = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P(AXIS)),
+            out_specs=(P(), P()), check_vma=False,
+        ))
+        return _built(step, L)
+
+    return ContractSpec(
+        name="numerics_dropped_residual", build=build, axes=(AXIS,),
+        grad_reduce=(GradReduce(AXIS, ("psum",)),),
+        numerics=NumericsPolicy(quantized=True, error_feedback=True,
+                                accum_dtype="int32"),
+    )
+
+
+def _numerics_widened_accum() -> ContractSpec:
+    L = 32
+
+    def build() -> Built:
+        mesh = _mesh_1d()
+
+        def f(p, x):
+            loss = jnp.sum(p[:4] * x[0])
+            g = jax.grad(lambda q: jnp.sum(q[:4] * x[0]))(p)
+            scale = jnp.max(jnp.abs(g)) / 127.0
+            q = jnp.clip(g / scale, -127, 127).astype(jnp.int8)
+            # BUG: PR 12's regression — the homomorphic wire declares
+            # the minimal exact int16 accumulator, but the psum quietly
+            # widened back to int32. No WirePolicy is declared, so the
+            # byte-level rule (PSC103) is blind; only the traced-lattice
+            # dtype pin can see it
+            s = lax.psum(q.astype(jnp.int32), AXIS)
+            deq = s.astype(jnp.float32) * (scale / N)
+            return p - 0.1 * deq, lax.pmean(loss, AXIS)
+
+        step = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P(AXIS)),
+            out_specs=(P(), P()), check_vma=False,
+        ))
+        return _built(step, L)
+
+    return ContractSpec(
+        name="numerics_widened_accum", build=build, axes=(AXIS,),
+        grad_reduce=(GradReduce(AXIS, ("psum",)),),
+        numerics=NumericsPolicy(quantized=True, accum_dtype="int16"),
+    )
+
+
+def _numerics_scan_opaque() -> ContractSpec:
+    L = 32
+
+    def build() -> Built:
+        mesh = _mesh_1d()
+
+        def f(p, x):
+            loss = jnp.sum(p[:4] * x[0])
+            g = jax.grad(lambda q: jnp.sum(q[:4] * x[0]))(p)
+            scale = jnp.max(jnp.abs(g)) / 127.0
+            q = jnp.clip(g / scale, -127, 127).astype(jnp.int8)
+            w = q.astype(jnp.int32)
+
+            # BUG: the lattice payload accumulates through a scan carry
+            # before the reduce — the analyzer widens the carry to
+            # unknown, so the psum's |sum| bound is unprovable and the
+            # capacity rule must refuse, not pass vacuously
+            def body(c, _):
+                return c + w, None
+
+            acc, _ = lax.scan(body, jnp.zeros_like(w), None, length=3)
+            s = lax.psum(acc, AXIS)
+            deq = s.astype(jnp.float32) * (scale / N)
+            return p - 0.1 * deq, lax.pmean(loss, AXIS)
+
+        step = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P(AXIS)),
+            out_specs=(P(), P()), check_vma=False,
+        ))
+        return _built(step, L)
+
+    return ContractSpec(
+        name="numerics_scan_opaque", build=build, axes=(AXIS,),
+        grad_reduce=(GradReduce(AXIS, ("psum",)),),
+        numerics=_NUM_INT32,
+    )
+
+
+def _numerics_silent_downcast() -> ContractSpec:
+    def build() -> Built:
+        mesh = _mesh_1d()
+
+        def f(p, x):
+            loss = jnp.sum(p[:4] * x[0])
+            g = jax.grad(lambda q: jnp.sum(q[:4] * x[0]))(p)
+            g = lax.psum(g, AXIS)
+            # BUG: the update path round-trips through bf16 after the
+            # gradient reduce — not a quantize site (no clamp, no
+            # scale), not a declared allowance: silent precision loss
+            new_p = (p - 0.1 * g).astype(jnp.bfloat16)
+            return new_p.astype(jnp.float32), lax.pmean(loss, AXIS)
+
+        step = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P(AXIS)),
+            out_specs=(P(), P()), check_vma=False,
+        ))
+        return _built(step, 8)
+
+    return ContractSpec(
+        name="numerics_silent_downcast", build=build, axes=(AXIS,),
+        grad_reduce=(GradReduce(AXIS, ("psum",)),),
+        numerics=NumericsPolicy(quantized=False),
+    )
+
+
+def _numerics_ef_closed() -> ContractSpec:
+    L = 32
+
+    def build() -> Built:
+        mesh = _mesh_1d()
+
+        def f(p, err, x):
+            loss = jnp.sum(p[:4] * x[0])
+            g = jax.grad(lambda q: jnp.sum(q[:4] * x[0]))(p) + err
+            scale = jnp.max(jnp.abs(g)) / 127.0
+            q = jnp.clip(g / scale, -127, 127).astype(jnp.int8)
+            s = lax.psum(q.astype(jnp.int32), AXIS)
+            deq = s.astype(jnp.float32) * (scale / N)
+            # the closed loop: the residual subtracts the SAME dequant
+            # chain's local contribution and feeds the next step's carry
+            new_err = g - q.astype(jnp.float32) * scale
+            return p - 0.1 * deq, new_err, lax.pmean(loss, AXIS)
+
+        step = jax.jit(jax.shard_map(
+            f, mesh=mesh, in_specs=(P(), P(), P(AXIS)),
+            out_specs=(P(), P(), P()), check_vma=False,
+        ))
+        params, x = _args(L)
+        err = jax.ShapeDtypeStruct((L,), jnp.float32)
+        return Built(step=step, args=(params, err, x),
+                     select_params=lambda out: out[0])
+
+    return ContractSpec(
+        name="numerics_ef_closed", build=build, axes=(AXIS,),
+        grad_reduce=(GradReduce(AXIS, ("psum",)),),
+        numerics=NumericsPolicy(quantized=True, error_feedback=True,
+                                accum_dtype="int32"),
+    )
+
+
 def _ok_psum() -> ContractSpec:
     return ContractSpec(
         name="ok_psum",
@@ -431,5 +655,11 @@ def get_contracts():
         _adaptive_no_consensus(),
         _homomorphic_widened(),
         _depipelined(),
+        _numerics_fresh_scale(),
+        _numerics_dropped_residual(),
+        _numerics_widened_accum(),
+        _numerics_scan_opaque(),
+        _numerics_silent_downcast(),
+        _numerics_ef_closed(),
         _ok_psum(),
     )

@@ -165,7 +165,10 @@ def fixture_contract(tmp_path_factory):
         "dead_axis", "metrics_only", "fat_f32_wire", "drift",
         "undonated", "donate_mismatch", "defused", "serve_chatty",
         "serve_f32_kv", "adaptive_fat_wire", "adaptive_no_consensus",
-        "homomorphic_widened", "depipelined", "ok_psum",
+        "homomorphic_widened", "depipelined", "numerics_fresh_scale",
+        "numerics_dropped_residual", "numerics_widened_accum",
+        "numerics_scan_opaque", "numerics_silent_downcast",
+        "numerics_ef_closed", "ok_psum",
     }
     data["configs"]["drift"]["collectives"][0]["bytes"] += 1
     path.write_text(json.dumps(data))
@@ -188,6 +191,11 @@ def fixture_contract(tmp_path_factory):
         ("adaptive_no_consensus", "PSC110"),
         ("homomorphic_widened", "PSC103"),
         ("depipelined", "PSC109"),
+        ("numerics_fresh_scale", "PSC111"),
+        ("numerics_dropped_residual", "PSC112"),
+        ("numerics_widened_accum", "PSC113"),
+        ("numerics_scan_opaque", "PSC113"),
+        ("numerics_silent_downcast", "PSC114"),
     ],
 )
 def test_fixture_trips_exactly_one_rule(fixture_contract, name, rule):
@@ -200,9 +208,10 @@ def test_fixture_trips_exactly_one_rule(fixture_contract, name, rule):
     assert rules == [rule], out
 
 
-def test_clean_fixture_passes(fixture_contract):
+@pytest.mark.parametrize("name", ["ok_psum", "numerics_ef_closed"])
+def test_clean_fixture_passes(fixture_contract, name):
     rc, out = _run_main(
-        ["--registry", FIXTURES, "--only", "ok_psum", "--contract",
+        ["--registry", FIXTURES, "--only", name, "--contract",
          str(fixture_contract), "--format", "json"]
     )
     assert rc == 0, out
@@ -231,6 +240,31 @@ def test_cli_usage_errors(tmp_path):
     assert not (tmp_path / "c.json").exists()
     rc, _ = _run_main(["--registry", "tests.no_such_registry_xyz"])
     assert rc == 2
+
+
+def test_cli_select_filters_findings(fixture_contract):
+    """`--select` mirrors pslint's semantics: filter to the named
+    rules, exit 0 when none of them fire."""
+    base = ["--registry", FIXTURES, "--only", "numerics_fresh_scale",
+            "--contract", str(fixture_contract), "--format", "json"]
+    rc, out = _run_main(base + ["--select", "PSC111"])
+    assert rc == 1
+    assert {f["rule"] for f in json.loads(out)["findings"]} == {"PSC111"}
+    # the PSC111 violation is invisible through a PSC112-only lens
+    rc, out = _run_main(base + ["--select", "psc112"])  # case-folded
+    assert rc == 0
+    assert json.loads(out)["findings"] == []
+
+
+def test_cli_select_usage_errors(tmp_path):
+    rc, _ = _run_main(["--registry", FIXTURES, "--select", "PSC999"])
+    assert rc == 2
+    rc, _ = _run_main(
+        ["--registry", FIXTURES, "--write-contract",
+         "--contract", str(tmp_path / "c.json"), "--select", "PSC111"]
+    )
+    assert rc == 2
+    assert not (tmp_path / "c.json").exists()
 
 
 def test_cli_list_names_registry_configs():
@@ -280,7 +314,7 @@ def test_check_sh_write_with_contract_value_is_not_refused(tmp_path):
     # rc 1: the broken fixtures trip their rules, but the write happened
     # (no exit-2 refusal from the shell gate)
     assert proc.returncode == 1, proc.stdout + proc.stderr
-    assert "wrote 14 config(s)" in proc.stdout
+    assert "wrote 20 config(s)" in proc.stdout
     assert out.exists()
 
 

@@ -1,0 +1,341 @@
+"""psnumerics (check/numerics.py): the precision-flow analyzer's own
+tier-1 pins.
+
+Three families of proof, all from traced jaxprs and nothing else:
+
+- capacity cross-check (PSC113's ground truth): the traced worst-case
+  |sum| over the traced collective axis sizes must agree with the
+  config-time ``ops.quantize.ACCUM_CAPACITY`` table for EVERY quantized
+  registry config — including the 258-worker int16 threshold, proved
+  at 258 and refused at 259 from the trace alone;
+- exactness boundaries: the analysis stays exact through pjit /
+  shard_map / custom_vjp nesting, and degrades to "unknown, not clean"
+  (never vacuous) when a payload bound crosses a scan/while carry;
+- error-feedback closure (PSC112): the REAL engine's EF path — whose
+  residual round-trips a recomputed quantization, not the wire's own
+  eqns — is proven closed, and the dropped / double-counted variants
+  are flagged.
+
+Tracing is CPU-only and executes nothing.
+"""
+
+import math
+import types
+
+import pytest
+
+import ps_pytorch_tpu  # noqa: F401  (installs the jax.shard_map alias)
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from ps_pytorch_tpu.check import NumericsPolicy, analyze_numerics
+from ps_pytorch_tpu.check.contracts import (
+    MESH_DEVICES,
+    Built,
+    ContractSpec,
+    GradReduce,
+    _cnn_ps_built,
+    get_contracts,
+)
+from ps_pytorch_tpu.check.core import trace_spec
+from ps_pytorch_tpu.check.rules import (
+    psc111_scale_provenance,
+    psc112_error_feedback,
+    psc113_capacity,
+    psc114_downcast,
+)
+from ps_pytorch_tpu.ops.quantize import ACCUM_CAPACITY, accum_dtype
+from ps_pytorch_tpu.parallel.mesh import DCN_AXIS, WORKER_AXIS
+from ps_pytorch_tpu.parallel.ps import PSConfig
+
+AX = WORKER_AXIS
+
+
+def _numerics_findings(r):
+    return (psc111_scale_provenance(r) + psc112_error_feedback(r)
+            + psc113_capacity(r) + psc114_downcast(r))
+
+
+def _fake_result(rep, policy):
+    """Wrap a bare NumericsReport so the real rules can run on it."""
+    return types.SimpleNamespace(
+        spec=types.SimpleNamespace(name="synthetic", numerics=policy),
+        numerics=rep,
+    )
+
+
+# ------------------------------------------------- capacity (PSC113)
+
+def test_accum_capacity_table_matches_payload_math():
+    # the config-time table is floor(iinfo.max / 127) — the analyzer's
+    # traced bound (n_summands * 127) must flip at exactly the same n
+    for name, cap in ACCUM_CAPACITY.items():
+        imax = int(np.iinfo(name).max)
+        assert 127 * cap <= imax < 127 * (cap + 1)
+    assert ACCUM_CAPACITY["int16"] == 258
+    assert accum_dtype(258) == jnp.int16
+    assert accum_dtype(259) == jnp.int32
+
+
+def _int16_wire_report(n_workers):
+    def chain(g):
+        scale = lax.pmax(jnp.max(jnp.abs(g)), AX) / 127.0
+        q = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int16)
+        return lax.psum(q, AX).astype(jnp.float32) * scale
+
+    closed = jax.make_jaxpr(chain, axis_env=[(AX, n_workers)])(
+        jax.ShapeDtypeStruct((32,), jnp.float32)
+    )
+    return analyze_numerics(closed, param_out_indices=[0],
+                            axis_sizes={AX: n_workers})
+
+
+def test_int16_wire_proved_at_258_refused_at_259():
+    """The 258-worker threshold is DERIVED from the trace, not trusted
+    from the config table: 127 * 258 = 32766 fits int16, 127 * 259 =
+    32893 does not — and the refusal comes from the analyzer's own
+    traced bound."""
+    pol = NumericsPolicy(quantized=True, accum_dtype="int16")
+
+    rep = _int16_wire_report(258)
+    (ev,) = [a for a in rep.accums if a.kind == "psum"]
+    assert ev.dtype == "int16" and ev.multiplier == 258
+    assert ev.peak_out == 127.0 * 258 == 32766.0
+    assert ev.capacity == 32767 and ev.peak_out <= ev.capacity
+    assert psc113_capacity(_fake_result(rep, pol)) == []
+
+    rep = _int16_wire_report(259)
+    (ev,) = [a for a in rep.accums if a.kind == "psum"]
+    assert ev.multiplier == 259
+    assert ev.peak_out == 127.0 * 259 == 32893.0
+    assert ev.peak_out > ev.capacity
+    findings = psc113_capacity(_fake_result(rep, pol))
+    assert any(f.rule == "PSC113" and "32893" in f.message
+               for f in findings), findings
+
+
+@pytest.fixture(scope="module")
+def quantized_results():
+    """Every registry config that declares a quantized wire with an
+    accumulator dtype, traced once."""
+    specs = [s for s in get_contracts()
+             if s.numerics and s.numerics.quantized
+             and s.numerics.accum_dtype]
+    assert len(specs) >= 20  # the whole compressed-wire family
+    return [trace_spec(s) for s in specs]
+
+
+def test_registry_traced_bounds_fit_declared_capacity(quantized_results):
+    """Satellite cross-check: for every quantized config the ANALYZER's
+    worst-case |sum| (traced axis sizes x payload range) must fit the
+    accumulator the config-time ACCUM_CAPACITY table picked — the table
+    is now a verified claim, not a trusted one."""
+    for r in quantized_results:
+        name = r.spec.name
+        pol = r.spec.numerics
+        rep = r.numerics
+        lattice = [a for a in rep.accums
+                   if a.lattice and a.dtype.startswith("int")]
+        assert lattice, name  # a quantized wire with no integer sums
+        #                       would be a vacuous pass
+        for a in lattice:
+            assert a.peak_out is not None, (name, a)  # proven, not
+            #                                           assumed
+            assert a.capacity is not None and a.peak_out <= a.capacity, \
+                (name, a)
+            if a.axes:  # collective hop: multiplier is the TRACED size
+                assert a.multiplier == math.prod(
+                    rep.axis_sizes[ax] for ax in a.axes), (name, a)
+        # the reduce itself rides exactly the declared accumulator
+        for a in lattice:
+            if a.kind in ("psum", "psum_scatter"):
+                assert a.dtype == pol.accum_dtype, (name, a)
+        # config-time table agrees with the traced mesh
+        total = math.prod(rep.axis_sizes.get(ax, 1) for ax in r.spec.axes)
+        assert total == MESH_DEVICES, name
+        assert total <= ACCUM_CAPACITY[pol.accum_dtype], name
+
+
+def test_hier_worst_case_is_product_of_both_axes(quantized_results):
+    """The hierarchical wire pays one bounded hop per axis (ICI sum of
+    4, then a requantized DCN sum of 2); the capacity claim for the
+    whole scheme is the PRODUCT of both traced axis sizes."""
+    r = next(r for r in quantized_results if r.spec.name
+             == "ps_hier_int8_2round_replicated_bucketed_homomorphic")
+    rep = r.numerics
+    sizes = rep.axis_sizes
+    assert sizes[DCN_AXIS] * sizes[WORKER_AXIS] == MESH_DEVICES
+    lattice = [a for a in rep.accums if a.lattice]
+    assert sorted({a.multiplier for a in lattice}) == sorted(
+        {sizes[DCN_AXIS], sizes[WORKER_AXIS]})
+    for a in lattice:
+        # each hop sums freshly-requantized +-127 payloads: the traced
+        # peak is exactly multiplier * 127, well inside its capacity
+        assert a.peak_out == 127.0 * a.multiplier <= a.capacity
+
+
+# ------------------------------- exactness boundaries (satellite 3)
+
+def _quant_psum(g):
+    scale = lax.pmax(jnp.max(jnp.abs(g)), AX) / 127.0
+    q = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int8)
+    s = lax.psum(q.astype(jnp.int32), AX)
+    return s.astype(jnp.float32) * scale / float(MESH_DEVICES)
+
+
+def _analyze(fn, n=MESH_DEVICES):
+    closed = jax.make_jaxpr(fn, axis_env=[(AX, n)])(
+        jax.ShapeDtypeStruct((16,), jnp.float32)
+    )
+    return analyze_numerics(closed, param_out_indices=[0],
+                            axis_sizes={AX: n})
+
+
+def _assert_exact(rep):
+    (site,) = [s for s in rep.sites if s.primary]
+    assert site.peak == 127.0 and not site.conservative
+    (ev,) = [a for a in rep.accums if a.kind == "psum"]
+    assert ev.peak_out == 127.0 * MESH_DEVICES and not ev.conservative
+    pol = NumericsPolicy(quantized=True, accum_dtype="int32")
+    assert _numerics_findings(_fake_result(rep, pol)) == []
+
+
+def test_exact_through_pjit():
+    _assert_exact(_analyze(jax.jit(_quant_psum)))
+
+
+def test_exact_through_custom_vjp():
+    @jax.custom_vjp
+    def ident(x):
+        return x
+
+    ident.defvjp(lambda x: (x, None), lambda _, ct: (ct,))
+    _assert_exact(_analyze(lambda g: _quant_psum(ident(g))))
+
+
+def test_exact_through_shard_map_with_discovered_axis_size():
+    mesh = Mesh(np.array(jax.devices()[:MESH_DEVICES]), (AX,))
+    mapped = jax.shard_map(
+        _quant_psum, mesh=mesh, in_specs=P(AX), out_specs=P(),
+        check_vma=False,
+    )
+    closed = jax.make_jaxpr(mapped)(
+        jax.ShapeDtypeStruct((MESH_DEVICES, 16), jnp.float32)
+    )
+    # no explicit axis_sizes: the size comes off the shard_map eqn
+    rep = analyze_numerics(closed, param_out_indices=[0])
+    assert rep.axis_sizes == {AX: MESH_DEVICES}
+    _assert_exact(rep)
+
+
+@pytest.mark.parametrize("loop", ["scan", "while"])
+def test_loop_carry_degrades_to_unknown_not_clean(loop):
+    """A payload bound crossing a scan/while carry is UNKNOWN — the
+    collective event must still exist (never vacuous) with no provable
+    bound, and PSC113 must say "cannot prove", not pass."""
+
+    def chain(g):
+        scale = lax.pmax(jnp.max(jnp.abs(g)), AX) / 127.0
+        q = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int8)
+        w = q.astype(jnp.int32)
+        if loop == "scan":
+            acc, _ = lax.scan(lambda c, _: (c + w, None),
+                              jnp.zeros_like(w), None, length=3)
+        else:
+            acc = lax.while_loop(lambda c: jnp.sum(c) < 10 ** 9,
+                                 lambda c: c + w, jnp.zeros_like(w))
+        s = lax.psum(acc, AX)
+        return s.astype(jnp.float32) * scale
+
+    rep = _analyze(chain)
+    psums = [a for a in rep.accums if a.kind == "psum"]
+    assert psums and all(a.peak_out is None for a in psums)
+    pol = NumericsPolicy(quantized=True, accum_dtype="int32")
+    findings = psc113_capacity(_fake_result(rep, pol))
+    assert any(f.rule == "PSC113" and "cannot prove" in f.message
+               for f in findings), findings
+
+
+# --------------------------- error-feedback closure (PSC112)
+
+def _ef_spec(wire_domain, accum, error_feedback=True):
+    cfg = PSConfig(num_workers=MESH_DEVICES, compress="int8",
+                   error_feedback=error_feedback,
+                   wire_domain=wire_domain)
+    return ContractSpec(
+        name=f"ef_{wire_domain}",
+        build=lambda: _cnn_ps_built(cfg, "LeNet"),
+        axes=(WORKER_AXIS,),
+        grad_reduce=(GradReduce(WORKER_AXIS, ("psum",)),),
+        numerics=NumericsPolicy(quantized=True, error_feedback=True,
+                                accum_dtype=accum),
+    )
+
+
+@pytest.mark.parametrize("wd,accum", [("dequant", "int32"),
+                                      ("homomorphic", "int16")])
+def test_real_error_feedback_step_proven_closed(wd, accum):
+    """The engine's EF residual is computed from a RECOMPUTED
+    quantization (collectives.local_quantized_contribution), not the
+    wire's own eqns — the analyzer must still prove every wire site
+    closed, via the same-minuend / same-geometry mirror match."""
+    r = trace_spec(_ef_spec(wd, accum))
+    assert _numerics_findings(r) == []
+    rep = r.numerics
+    live = [res for res in rep.residuals
+            if res.feeds_carry and not res.feeds_params]
+    assert len(live) == 8  # one residual per LeNet param leaf
+    covered = frozenset().union(*[res.covered_sites for res in live])
+    primary = {s.sid for s in rep.sites if s.primary}
+    assert primary and primary <= covered
+
+
+def test_error_feedback_dropped_residual_flagged():
+    # the policy declares EF but the engine wiring is off: the wire
+    # quantizes and nothing subtracts — the exact regression PSC112
+    # exists to catch
+    r = trace_spec(_ef_spec("dequant", "int32", error_feedback=False))
+    findings = psc112_error_feedback(r)
+    assert findings and all("residual" in f.message for f in findings)
+
+
+def test_error_feedback_double_count_flagged():
+    """A residual that is carried to the next step AND folded into this
+    step's parameter update corrects the same error twice."""
+
+    def step(p, err, x):
+        g = jnp.mean(x, axis=0) * jnp.cos(p) + err
+        scale = lax.pmax(jnp.max(jnp.abs(g)), AX) / 127.0
+        q = jnp.clip(jnp.round(g / scale), -127, 127).astype(jnp.int8)
+        s = lax.psum(q.astype(jnp.int32), AX)
+        deq = s.astype(jnp.float32) * (scale / float(MESH_DEVICES))
+        new_err = g - q.astype(jnp.float32) * scale
+        new_p = p - 0.1 * (deq + new_err)  # residual applied AND carried
+        return new_p, new_err
+
+    def build():
+        mesh = Mesh(np.array(jax.devices()[:MESH_DEVICES]), (AX,))
+        mapped = jax.shard_map(
+            step, mesh=mesh, in_specs=(P(), P(), P(AX)),
+            out_specs=(P(), P()), check_vma=False,
+        )
+        args = (jax.ShapeDtypeStruct((32,), jnp.float32),
+                jax.ShapeDtypeStruct((32,), jnp.float32),
+                jax.ShapeDtypeStruct((MESH_DEVICES, 32), jnp.float32))
+        return Built(step=mapped, args=args,
+                     select_params=lambda out: out[0])
+
+    spec = ContractSpec(
+        name="ef_double_count",
+        build=build,
+        axes=(WORKER_AXIS,),
+        grad_reduce=(GradReduce(WORKER_AXIS, ("psum",)),),
+        numerics=NumericsPolicy(quantized=True, error_feedback=True,
+                                accum_dtype="int32"),
+    )
+    findings = psc112_error_feedback(trace_spec(spec))
+    assert any("twice" in f.message or "double" in f.message
+               for f in findings), findings

@@ -1,6 +1,6 @@
 #!/usr/bin/env bash
 # pscheck entry point: jaxpr-level contract checking of the parallel
-# schemes (rules PSC101-PSC110) against runs/comm_contract.json.
+# schemes (rules PSC101-PSC114) against runs/comm_contract.json.
 #
 #   tools/check.sh                   # gate: trace the registry, verify all
 #                                    # contracts + the committed accounting
@@ -8,6 +8,9 @@
 #                                              # checking is skipped)
 #   tools/check.sh --write-contract  # refresh runs/comm_contract.json
 #                                    # after a deliberate wire change
+#   tools/check.sh --select PSC111,PSC112,PSC113,PSC114   # numerics-only
+#                                    # rule subset (pslint --select
+#                                    # semantics; unknown ids exit 2)
 #
 # Exit 0 = every contract holds, 1 = findings, 2 = usage error. The same
 # check runs in tier-1 via tests/test_check.py, so a wire regression in
@@ -22,7 +25,7 @@ REFUSE="tools/check.sh: pscheck takes no positional paths; a
 positional arguments, or call python -m ps_pytorch_tpu.check directly
 with an explicit --registry/--contract."
 
-gate_dispatch --write-contract "--contract --registry --only --format" \
+gate_dispatch --write-contract "--contract --registry --only --format --select" \
     "$REFUSE" \
     python -m ps_pytorch_tpu.check -- \
     python -m ps_pytorch_tpu.check -- \

@@ -41,6 +41,22 @@ trap 'rm -rf "$TMP"' EXIT
 # the smoke uses.
 run bash tools/lint.sh --select PSL006,PSL007,PSL008
 run bash tools/lint.sh
+
+# psnumerics precision-flow gate (PSC111-114) runs as the check phase's
+# first step: the full registry must PROVE its quantized-wire numerics
+# clean, and each broken fixture must still trip its rule — an analyzer
+# that stopped seeing anything would otherwise pass vacuously.
+run bash tools/check.sh --select PSC111,PSC112,PSC113,PSC114
+for pair in numerics_fresh_scale:PSC111 numerics_dropped_residual:PSC112 \
+            numerics_widened_accum:PSC113 numerics_silent_downcast:PSC114; do
+  fixture="${pair%%:*}"; rule="${pair##*:}"
+  if run bash tools/check.sh --registry tests.check_fixtures \
+         --only "$fixture" --select "$rule"; then
+    echo "numerics smoke: fixture $fixture did not trip $rule"; exit 1
+  fi
+done
+run bash tools/check.sh --registry tests.check_fixtures \
+    --only numerics_ef_closed --select PSC111,PSC112,PSC113,PSC114
 run bash tools/check.sh
 
 run python -c "import __graft_entry__ as g; g.dryrun_multichip(8)"
