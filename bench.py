@@ -457,6 +457,26 @@ def _wire_tag() -> str:
     return ""
 
 
+# BENCH_AB_PRECISION=1 runs the CNN workload TWICE in one process —
+# static int8 (PSConfig.precision_adapt off) then the telemetry-adaptive
+# per-bucket wire (§6i: a PrecisionController retags buckets skip/4-bit/
+# int8/hi from the step's bucket_sqnorm telemetry, values-not-bytes, no
+# retrace) on the SAME 64 KiB bucketed wire — and emits both in ONE
+# record: per-variant walltime, backend stamp, the committed contract's
+# comm shape, and the adaptive variant's tag histogram + effective wire
+# bytes next to its static-int8 baseline, so the record shows what a
+# byte-honest transport would ship. BENCH_WIRE_BUDGET_BYTES (optional)
+# caps the adaptive variant's effective bytes (--wire-budget-bytes): on
+# smoke-sized windows the density ladder's debounce may adopt nothing,
+# and a budget just above the all-4-bit floor makes the retag
+# deterministic. Needs an int8-family wire; mutually exclusive with the
+# other A/B dimensions.
+def _precision_tag() -> str:
+    if os.environ.get("BENCH_AB_PRECISION") == "1":
+        return "_ab_precision"
+    return ""
+
+
 def _grad_wire_bytes(entry) -> int:
     """Gradient-path payload bytes from a contract entry's rows: drop
     the declared overheads — scale pmax rows, the guard pmin, the
@@ -487,15 +507,16 @@ def _grad_wire_bytes(entry) -> int:
 
 
 def _comm_contract_entry(workload: str, compress, bucket_bytes,
-                         wire_domain: str = "dequant"):
+                         wire_domain: str = "dequant",
+                         precision_adapt: bool = False):
     """The committed pscheck accounting row for the PS config this CNN
     workload trains: {config, n_collectives, wire_bytes,
     grad_wire_bytes, mesh_devices} from runs/comm_contract.json, or
     None when the registry has no matching traced entry. Contract
-    entries are keyed by config name and traced with a FIXED bucket
-    plan (LeNet variants pin the fused plan, ResNet the 4 MiB plan), so
-    only exact bucket matches attach — mislabeling a different carving
-    would be worse than omitting."""
+    entries are keyed by config name and traced with FIXED bucket plans
+    (LeNet variants pin the fused plan plus a 64 KiB carving, ResNet
+    the 4 MiB plan), so only exact bucket matches attach — mislabeling
+    a different carving would be worse than omitting."""
     name = "ps_"
     if workload == "resnet18":
         name += "resnet18_"
@@ -505,13 +526,18 @@ def _comm_contract_entry(workload: str, compress, bucket_bytes,
         if workload == "resnet18":
             from ps_pytorch_tpu.check.contracts import RESNET_BUCKET_BYTES
 
-            traced_bb = RESNET_BUCKET_BYTES
+            traced = {RESNET_BUCKET_BYTES: ""}
         else:
-            traced_bb = 0  # LeNet variants are traced with the fused plan
-        if bucket_bytes != traced_bb:
+            # fused plan (the legacy LeNet trace) or the 64 KiB carving
+            # the precision-adapt registry pair rides
+            traced = {0: "", 64 << 10: "64k"}
+        if bucket_bytes not in traced:
             return None
+        name += traced[bucket_bytes]
     if wire_domain == "homomorphic":
         name += "_homomorphic"
+    if precision_adapt:
+        name += "_precadapt"
     here = os.path.dirname(os.path.abspath(__file__))
     try:
         with open(os.path.join(here, "runs", "comm_contract.json")) as f:
@@ -754,10 +780,11 @@ def _validate_env() -> None:
     # wrapper exporting it globally must not abort the lm/decode legs
     for knob in ("BENCH_BUCKET_BYTES", "BENCH_AB_BUCKETING",
                  "BENCH_AB_STATE_LAYOUT", "BENCH_AB_OVERLAP",
-                 "BENCH_AB_WIRE"):
+                 "BENCH_AB_WIRE", "BENCH_AB_PRECISION"):
         val = os.environ.get(knob)
         if knob in ("BENCH_AB_BUCKETING", "BENCH_AB_STATE_LAYOUT",
-                    "BENCH_AB_OVERLAP", "BENCH_AB_WIRE") and val == "0":
+                    "BENCH_AB_OVERLAP", "BENCH_AB_WIRE",
+                    "BENCH_AB_PRECISION") and val == "0":
             val = None
         if val is not None and os.environ.get(
             "BENCH_WORKLOAD", "lenet"
@@ -768,7 +795,8 @@ def _validate_env() -> None:
             )
     ab_on = [
         k for k in ("BENCH_AB_BUCKETING", "BENCH_AB_STATE_LAYOUT",
-                    "BENCH_AB_OVERLAP", "BENCH_AB_WIRE")
+                    "BENCH_AB_OVERLAP", "BENCH_AB_WIRE",
+                    "BENCH_AB_PRECISION")
         if os.environ.get(k) == "1"
     ]
     if len(ab_on) > 1:
@@ -785,6 +813,24 @@ def _validate_env() -> None:
                 "domain has nothing to sum on an f32 psum) — set "
                 "BENCH_COMPRESS=int8 or int8_2round, or pick a workload "
                 "whose canonical mode is compressed (resnet18)"
+            )
+    if os.environ.get("BENCH_AB_PRECISION") == "1":
+        name = os.environ.get("BENCH_WORKLOAD", "lenet")
+        mode, _ = _cnn_compress(WORKLOADS.get(name, {}).get("compress"))
+        if mode not in ("int8", "int8_2round"):
+            raise SystemExit(
+                "BENCH_AB_PRECISION needs an int8-family wire (the "
+                "adaptive lattice retags quantized buckets) — set "
+                "BENCH_COMPRESS=int8 or int8_2round"
+            )
+    if os.environ.get("BENCH_WIRE_BUDGET_BYTES") is not None:
+        try:
+            if int(os.environ["BENCH_WIRE_BUDGET_BYTES"]) < 1:
+                raise ValueError
+        except ValueError:
+            raise SystemExit(
+                f"BENCH_WIRE_BUDGET_BYTES must be an integer >= 1, "
+                f"got {os.environ['BENCH_WIRE_BUDGET_BYTES']!r}"
             )
     if os.environ.get("BENCH_BUCKET_BYTES") is not None:
         try:
@@ -808,7 +854,8 @@ def _validate_env() -> None:
                 "or unset it for the 64 KiB default"
             )
     for knob in ("BENCH_AB_BUCKETING", "BENCH_AB_STATE_LAYOUT",
-                 "BENCH_AB_OVERLAP", "BENCH_AB_WIRE"):
+                 "BENCH_AB_OVERLAP", "BENCH_AB_WIRE",
+                 "BENCH_AB_PRECISION"):
         if os.environ.get(knob) not in (None, "0", "1"):
             raise SystemExit(
                 f"{knob} must be 0 or 1, got {os.environ[knob]!r}"
@@ -916,7 +963,8 @@ def _success_metric() -> str:
     metric = WORKLOADS.get(name, {}).get("metric") or f"{name}_train_throughput"
     _, ctag = _cnn_compress(WORKLOADS.get(name, {}).get("compress"))
     return (metric + ctag + _bucket_tag() + _layout_tag()
-            + _overlap_tag() + _wire_tag() + _cnn_dtype_suffix())
+            + _overlap_tag() + _wire_tag() + _precision_tag()
+            + _cnn_dtype_suffix())
 
 
 def _attach_banked(rec: dict) -> None:
@@ -1126,19 +1174,25 @@ def main() -> None:
     def run_variant(bucket_bytes, state_layout="flat",
                     probe_update_path=False, overlap="serial",
                     probe_overlap=False, spans=False,
-                    wire_domain="dequant"):
+                    wire_domain="dequant", precision_adapt=False):
         """Measure one (wire granularity, state layout, schedule) end to
         end; returns the variant's sub-record plus (loss, elapsed,
         steps, flops, chain). ``spans`` wraps the measured window in an
         in-memory obs tracer (per-step dispatch + sync spans) and
         ``probe_overlap`` adds the jaxpr schedule-freedom numbers —
-        both used by the BENCH_AB_OVERLAP leg."""
+        both used by the BENCH_AB_OVERLAP leg. ``precision_adapt``
+        arms the adaptive per-bucket wire (§6i): a host
+        PrecisionController retags buckets from per-step telemetry, so
+        this variant measures with a PER-STEP host fetch (the adaptive
+        wire's real cadence — chaining would hide the controller cost
+        the A/B exists to price)."""
         from ps_pytorch_tpu.optim import build_optimizer
 
         cfg = PSConfig(
             num_workers=n_dev, compress=compress,
             bucket_bytes=bucket_bytes, state_layout=state_layout,
             overlap=overlap, wire_domain=wire_domain,
+            precision_adapt=precision_adapt,
         )
         # the flat layout takes the whole-vector optimizer variant (the
         # trainer's own pairing); the math is bit-identical either way
@@ -1156,12 +1210,40 @@ def main() -> None:
         # computation retires, silently turning the benchmark into a
         # dispatch-rate measurement — and the loss alone does not
         # serialize the optimizer update, which feeds only the params.
+        controller = None
+        if precision_adapt:
+            from ps_pytorch_tpu.parallel.ps import state_plan
+            from ps_pytorch_tpu.resilience.precision import (
+                PrecisionController,
+            )
+
+            n_params = (
+                state.params.layout.total
+                if hasattr(state.params, "layout")
+                else sum(
+                    x.size for x in jax.tree_util.tree_leaves(state.params)
+                )
+            )
+            # a short window so the retag lands inside even a smoke-sized
+            # measured run — the A/B's evidence is the effective-bytes
+            # shrink, not a long-horizon policy trace
+            budget = os.environ.get("BENCH_WIRE_BUDGET_BYTES")
+            controller = PrecisionController(
+                cfg, state_plan(cfg, n_params).sizes, window=2,
+                budget_bytes=int(budget) if budget is not None else None,
+            )
+
+        def _extras():
+            if controller is None:
+                return ()
+            return (np.asarray(controller.tags, np.int32),)
+
         warm_t0 = time.perf_counter()
         for _ in range(2):
-            state, metrics = step(state, sharded, key)
+            state, metrics = step(state, sharded, key, *_extras())
         host_sync(state.params, metrics)
         warmup_s = time.perf_counter() - warm_t0
-        flops, hlo_ops = _step_cost(step, state, sharded, key)
+        flops, hlo_ops = _step_cost(step, state, sharded, key, *_extras())
         update_ops = None
         if probe_update_path:
             from ps_pytorch_tpu.check.opcount import update_path_op_count
@@ -1181,7 +1263,20 @@ def main() -> None:
         steps = req_steps
         k = min(_chain(), steps)  # same budget clamp as the lm path
         span_summary = None
-        if spans:
+        if controller is not None:
+            # per-step loop: each step ships under the CURRENT tag vector
+            # and feeds the controller its bucket_sqnorm telemetry (one
+            # host fetch per step — the adaptive wire's documented cost)
+            t0 = time.perf_counter()
+            for i in range(steps):
+                state, metrics = step(state, sharded, key, *_extras())
+                controller.record(
+                    i, np.asarray(jax.device_get(metrics["bucket_sqnorm"]))
+                )
+            host_sync(state.params, metrics)
+            elapsed = time.perf_counter() - t0
+            k = 1
+        elif spans:
             # per-step dispatch/sync spans via the in-memory tracer: the
             # dispatch span is the (async) enqueue, the sync span the
             # host's wait for the step to retire — per-step host_sync so
@@ -1231,11 +1326,26 @@ def main() -> None:
             # comm shape from the committed pscheck artifact, so the
             # perf trajectory records the wire, not just walltime
             "comm": _comm_contract_entry(
-                name, compress, bucket_bytes, wire_domain
+                name, compress, bucket_bytes, wire_domain, precision_adapt
             ),
         }
         sub["overlap"] = overlap
         sub["wire_domain"] = wire_domain
+        if controller is not None:
+            from ps_pytorch_tpu.ops.quantize import PRECISION_TAG_NAMES
+
+            # what a byte-honest transport ships under the final tags vs
+            # the static int8 baseline — the A/B's evidence metric
+            # (resilience/precision.py effective_wire_bytes)
+            sub["precision"] = {
+                "adaptations": int(controller.adaptations),
+                "effective_wire_bytes": int(controller.effective_bytes()),
+                "static_int8_bytes": int(controller.static_int8_bytes),
+                "tags": {
+                    nm: int((controller.tags == t).sum())
+                    for t, nm in enumerate(PRECISION_TAG_NAMES)
+                },
+            }
         if update_ops is not None:
             sub["update_path_ops"] = update_ops
         if overlap_probe is not None:
@@ -1425,6 +1535,58 @@ def main() -> None:
                 # homomorphic gradient-path wire bytes), when both
                 # carvings have traced entries
                 "grad_wire_bytes_ratio": wire_ratio,
+            },
+        }
+    elif os.environ.get("BENCH_AB_PRECISION") == "1":
+        # A/B leg: static int8 vs telemetry-adaptive per-bucket precision
+        # (§6i) on the SAME 64 KiB bucketed wire in one process — the
+        # adaptive variant carries its tag histogram, effective wire
+        # bytes, and static-int8 baseline, so the record shows the
+        # byte-honest shrink next to the measured walltime (which PAYS
+        # the per-step telemetry fetch — values-not-bytes means the
+        # traced wire itself never shrinks, PSC108). Headline = adaptive.
+        bb = _bench_bucket_bytes()
+        if bb is None or bb == 0:
+            # the precadapt contract pair is traced at the 64 KiB
+            # carving; a fused single bucket would also make the A/B
+            # degenerate (one tag re-prices the whole gradient)
+            bb = 64 << 10
+        sub_static, *_ = run_variant(bb)
+        sub_adapt, loss, elapsed, steps, flops, k = run_variant(
+            bb, precision_adapt=True
+        )
+        _require_same_backend(sub_static, sub_adapt)
+        images_per_sec = sub_adapt["images_per_sec"]
+        prec = sub_adapt.get("precision") or {}
+        eff = prec.get("effective_wire_bytes")
+        static_b = prec.get("static_int8_bytes")
+        rec = {
+            "run": _run_info(n_dev, device_kind),
+            "phases": sub_adapt["phases"],
+            "metric": _success_metric() + suffix,
+            "value": images_per_sec,
+            "unit": "images/sec",
+            "vs_baseline": round(images_per_sec / REF_IMAGES_PER_SEC, 2),
+            "mfu": _mfu(flops, steps, elapsed, jax, n_devices=n_dev),
+            "device": device_kind,
+            "backend": _backend_info(device_kind),
+            "timestamp": _utc_now(),
+            "hlo_op_count": sub_adapt["hlo_op_count"],
+            "comm": sub_adapt["comm"],
+            "ab_precision": {
+                "static_int8": sub_static,
+                "adaptive": sub_adapt,
+                "speedup": round(
+                    sub_adapt["images_per_sec"]
+                    / max(sub_static["images_per_sec"], 1e-9),
+                    3,
+                ),
+                # effective / static bytes under the final tag vector —
+                # < 1.0 is the adaptive wire earning its keep
+                "effective_wire_fraction": (
+                    round(eff / static_b, 3)
+                    if eff is not None and static_b else None
+                ),
             },
         }
     else:

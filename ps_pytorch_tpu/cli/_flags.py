@@ -101,7 +101,14 @@ def add_train_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
     parser.add_argument("--adapt-window", type=int, default=d.adapt_window,
                         help="adaptive aggregation window (steps): how often "
                              "the mask count is re-picked from step-time "
-                             "stats (with --num-aggregate-min/max)")
+                             "stats (with --num-aggregate-min/max); also the "
+                             "--precision-adapt telemetry window")
+    parser.add_argument("--wire-budget-bytes", type=int, default=None,
+                        help="with --precision-adapt: cap the per-step "
+                             "EFFECTIVE gradient wire bytes — over budget "
+                             "the controller downgrades the lowest-density "
+                             "buckets one lattice notch at a time (never "
+                             "below 4-bit)")
     return parser
 
 
@@ -203,6 +210,15 @@ def add_ps_flags(parser: argparse.ArgumentParser) -> argparse.ArgumentParser:
                              "reassembly ships int8 instead of f32). "
                              "Needs a --compress-grad mode and nearest "
                              "rounding")
+    parser.add_argument("--precision-adapt", action="store_true",
+                        help="adaptive per-bucket precision: the train step "
+                             "takes a traced skip/4-bit/int8/hi tag per wire "
+                             "bucket (no retrace on change) and a windowed "
+                             "gradient-norm controller re-picks the tags "
+                             "every --adapt-window steps, optionally under "
+                             "--wire-budget-bytes (needs a --compress-grad "
+                             "mode, --bucket-bytes >= 0 and nearest "
+                             "rounding; EF absorbs the added error)")
     parser.add_argument("--opt-placement", type=str, default="replicated",
                         choices=("replicated", "sharded"),
                         help="where optimizer state lives (sharded = ZeRO-1 PS)")
@@ -374,6 +390,7 @@ def train_config_from(args: argparse.Namespace) -> TrainConfig:
         max_consecutive_skips=args.max_consecutive_skips,
         fault_plan=args.fault_plan,
         adapt_window=args.adapt_window,
+        wire_budget_bytes=args.wire_budget_bytes,
     )
 
 
@@ -408,6 +425,7 @@ def ps_config_from(args: argparse.Namespace, num_workers: int) -> PSConfig:
         state_layout=args.state_layout,
         overlap="pipelined" if args.overlap == "on" else "serial",
         error_feedback=args.error_feedback,
+        precision_adapt=args.precision_adapt,
         opt_placement=args.opt_placement,
         bn_mode=args.bn_mode,
         grad_accum_steps=args.grad_accum_steps,

@@ -46,7 +46,14 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..models import apply_model
 from ..ops.metrics import accuracy, cross_entropy_loss
-from ..ops.quantize import accum_dtype, dequantize_int8, quantize_int8
+from ..ops.quantize import (
+    _INT8_PEAK,
+    accum_dtype,
+    dequantize_int8,
+    precision_peaks,
+    quantize_int8,
+    quantize_lattice,
+)
 from ..resilience.guard import (
     init_guard_state,
     tree_all_finite,
@@ -127,6 +134,21 @@ class PSConfig:
     # dequant wire is an envelope (EF absorbs the difference), while the
     # integer accumulation itself is bit-exact.
     wire_domain: str = "dequant"
+    # adaptive per-bucket precision (--precision-adapt): the train step
+    # takes a traced int32 vector of PER-BUCKET precision tags (one per
+    # state_plan bucket: 0=skip / 1=4-bit / 2=int8 / 3=hi) and quantizes
+    # each bucket onto the lattice its tag names — same block-scale
+    # geometry, shared scales, EF absorbing the extra error exactly as
+    # for static int8 — with NO retrace on tag change (the tag only
+    # selects the traced clipping peak). The host-side
+    # resilience/precision.PrecisionController picks tags per window
+    # from on-device per-bucket gradient-norm telemetry under a
+    # --wire-budget-bytes target. Value-domain adaptation: the physical
+    # trace bytes never change; the tags reshape what the fixed wire
+    # CARRIES (a 4-bit bucket's payload occupies 16 of 256 int8 code
+    # points), so the budget currency is EFFECTIVE bytes. Needs a
+    # compress mode, a bucketed wire, and nearest rounding.
+    precision_adapt: bool = False
     # gradient wire granularity (parallel/buckets.py): None = legacy
     # message-per-leaf collectives (the reference's tag-88+l shape), 0 =
     # ONE fused flat f32 buffer, N = ~N-byte contiguous buckets with
@@ -284,6 +306,24 @@ class PSConfig:
             accum_dtype(self.num_workers)
         if self.error_feedback and self.compress in (None, "none"):
             raise ValueError("error_feedback needs a compress mode")
+        if self.precision_adapt:
+            if self.compress in (None, "none"):
+                raise ValueError(
+                    "precision_adapt needs a compress mode: an "
+                    "uncompressed f32 wire has no lattice to retune"
+                )
+            if self.bucket_bytes is None:
+                raise ValueError(
+                    "precision_adapt needs a bucketed wire: set "
+                    "bucket_bytes (0 = one fused buffer, N = ~N-byte "
+                    "buckets) — the tags are a per-BUCKET property"
+                )
+            if self.quant_rounding != "nearest":
+                raise ValueError(
+                    "precision_adapt needs quant_rounding='nearest': the "
+                    "per-worker stochastic draws are calibrated to the "
+                    "int8 lattice pitch, not a per-bucket traced one"
+                )
         if self.dynamic_loss_scale:
             if self.compress in (None, "none"):
                 raise ValueError("dynamic_loss_scale needs a compress mode")
@@ -446,6 +486,30 @@ def state_plan(cfg: PSConfig, total: int) -> BucketPlan:
     if cfg.opt_placement == "sharded":
         return _sharded_plan(cfg, total)
     return plan_buckets(total, cfg.bucket_bytes or 0, align=wire_align(cfg))
+
+
+def precision_hi_peak(cfg: PSConfig) -> int:
+    """The static clipping peak a PREC_HI (f32-passthrough-fidelity)
+    bucket quantizes to under this config's wire — the widest lattice
+    the scheme's narrowest integer hop can carry without overflow:
+
+    - ``int8_2round``: the all_to_all payload is int8 by construction
+      (flat round 2 / hier DCN hop / sharded a2a), so HI caps at 127 —
+      on the 2-round wire the HI tag just means "never downgrade".
+    - homomorphic ``int8``: payloads accumulate exactly in
+      ``accum_dtype(num_workers)``, so the peak is that dtype's max
+      over the worker count (4095 at 8 workers on int16) — an
+      adaptive-precision dividend of PR 14's capacity analysis.
+    - dequant ``int8``: the psum rides int32, bounded only by
+      2^31-1 over the worker count; capped at 32767 so a HI payload
+      never needs more than an int16 carrier.
+    """
+    n = cfg.num_workers
+    if cfg.compress == "int8_2round":
+        return _INT8_PEAK
+    if cfg.wire_domain == "homomorphic":
+        return min(int(jnp.iinfo(accum_dtype(n)).max) // n, 32767)
+    return min((2 ** 31 - 1) // n, 32767)
 
 
 def init_ps_state(
@@ -666,23 +730,41 @@ def _pipelined_flat_update(tx, agg_buckets, opt_state, params: FlatVector,
 
 
 def _shard_reduce_bucket(bucket, size: int, axis, n: int, w, k, cfg,
-                         bkey, want_contrib: bool):
+                         bkey, want_contrib: bool, peak=None,
+                         hi_peak: int = _INT8_PEAK):
     """One bucket of the ZeRO-1 wire: (quantize) -> psum_scatter / int8
     all_to_all -> THIS worker's dequantized 1/n shard divided by the
     aggregation count. Shared by the serial and pipelined schedules so
     the per-bucket transform (and therefore the bytes and the values)
     can never diverge between them. Returns ``(g_shard [size//n],
-    contribution [size] or None)``."""
+    contribution [size] or None)``.
+
+    ``peak`` (adaptive precision): a traced f32 scalar selecting this
+    bucket's lattice — quantize_lattice at that peak instead of the
+    static int8 quantizer, same shared scales, same downstream sums
+    (a lattice payload is just an int8-or-narrower payload with fewer
+    live code points; ``hi_peak`` bounds the static clip so the int
+    casts below stay exact)."""
     s = size // n
     bsz = cfg.quant_block_size
     if cfg.compress in ("int8", "int8_2round"):
-        q, scale = quantize_int8(
-            bucket,
-            axis_name=axis,
-            block_size=bsz,
-            rounding=cfg.quant_rounding,
-            key=bkey,
-        )
+        if peak is not None:
+            q, scale = quantize_lattice(
+                bucket,
+                peak,
+                axis_name=axis,
+                block_size=bsz,
+                hi_peak=hi_peak,
+                out_dtype=jnp.int32,
+            )
+        else:
+            q, scale = quantize_int8(
+                bucket,
+                axis_name=axis,
+                block_size=bsz,
+                rounding=cfg.quant_rounding,
+                key=bkey,
+            )
         contrib = None
         if want_contrib:
             # what the wire carries after the int8 round trip — the
@@ -730,7 +812,8 @@ def _shard_reduce_bucket(bucket, size: int, axis, n: int, w, k, cfg,
 
 
 def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key,
-                       quant_key=None, err=None, agg_count=None):
+                       quant_key=None, err=None, agg_count=None,
+                       bucket_peaks=None):
     """ZeRO-1 "sharded PS": (EF add-back) -> mask -> (quantize) ->
     reduce_scatter per bucket -> per-shard optax update -> all_gather the
     parameter delta. The flat geometry comes from the buckets engine
@@ -802,20 +885,23 @@ def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key,
     if cfg.overlap == "pipelined":
         return _sharded_ps_update_pipelined(
             params, opt_state, grads, tx, cfg, layout, plan, w, k, sel,
-            bucket_key, err,
+            bucket_key, err, bucket_peaks=bucket_peaks,
         )
 
+    hi = precision_hi_peak(cfg) if bucket_peaks is not None else _INT8_PEAK
     flat_g = pad_flat(tree_to_flat(grads), plan)
     if err is not None:
         flat_g = flat_g + err
     sent = flat_g * sel if sel is not None else flat_g
     new_err = None
     g_shards, contribs = [], []
-    for start, size in zip(plan.starts, plan.sizes):
+    for bi, (start, size) in enumerate(zip(plan.starts, plan.sizes)):
         bucket = lax.slice(sent, (start,), (start + size,))
         g_b, contrib = _shard_reduce_bucket(
             bucket, size, axis, n, w, k, cfg, bucket_key(start),
             want_contrib=err is not None,
+            peak=None if bucket_peaks is None else bucket_peaks[bi],
+            hi_peak=hi,
         )
         g_shards.append(g_b)
         if contrib is not None:
@@ -851,7 +937,8 @@ def _sharded_ps_update(params, opt_state, grads, tx, cfg, mask_key,
 
 
 def _sharded_ps_update_pipelined(params, opt_state, grads, tx, cfg, layout,
-                                 plan, w, k, sel, bucket_key, err):
+                                 plan, w, k, sel, bucket_key, err,
+                                 bucket_peaks=None):
     """The ZeRO-1 update as a per-bucket stream (overlap="pipelined"):
     every bucket is assembled from its own gradient leaves
     (``assemble_bucket`` — no global ``tree_to_flat`` concat, so bucket
@@ -863,6 +950,7 @@ def _sharded_ps_update_pipelined(params, opt_state, grads, tx, cfg, layout,
     the serial schedule; only the dataflow (and therefore what a
     latency-hiding scheduler may interleave) changes."""
     axis, n = cfg.axis_name, cfg.num_workers
+    hi = precision_hi_peak(cfg) if bucket_peaks is not None else _INT8_PEAK
     segs = bucket_leaf_segments(layout, plan)
     order = readiness_bucket_order(plan)
     g_leaves = jax.tree_util.tree_leaves(grads)
@@ -893,6 +981,8 @@ def _sharded_ps_update_pipelined(params, opt_state, grads, tx, cfg, layout,
             g_shard_b, contrib = _shard_reduce_bucket(
                 sent_b, size, axis, n, w, k, cfg, bucket_key(start),
                 want_contrib=err is not None,
+                peak=None if bucket_peaks is None else bucket_peaks[b],
+                hi_peak=hi,
             )
             if err is not None:
                 err_parts[b] = g_b - contrib
@@ -976,6 +1066,13 @@ def make_ps_train_step(
     declared bounds so a host bug can never divide by zero or mask out
     everything. Same compiled program for every count — no retrace on
     adaptation.
+
+    cfg.precision_adapt appends a traced int32 ``prec_tags`` [n_buckets]
+    argument (after ``agg_count`` when both are on): per-bucket lattice
+    tags (skip/4-bit/int8/hi) the host-side PrecisionController updates
+    per window from the ``bucket_sqnorm`` metrics row this step emits.
+    Tags are clamped on device and only select traced clipping peaks, so
+    — like the count — every tag vector runs the same compiled program.
     """
     axis, n = cfg.axis_name, cfg.num_workers
     specs = state_specs(cfg)
@@ -987,7 +1084,12 @@ def make_ps_train_step(
     )
 
     def worker_fn(step_idx, params, opt_state, batch_stats, comm_state,
-                  guard_state, images, labels, key, agg_count=None):
+                  guard_state, images, labels, key, *extras):
+        # traced per-window controller inputs, in declaration order:
+        # agg_count (cfg.adaptive_aggregate), prec_tags (cfg.precision_adapt)
+        extras = list(extras)
+        agg_count = extras.pop(0) if cfg.adaptive_aggregate else None
+        prec_tags = extras.pop(0) if cfg.precision_adapt else None
         if agg_count is not None:
             # device-side clamp to the declared bounds: the contract the
             # PSC108 envelope relies on must hold even against a buggy
@@ -995,6 +1097,17 @@ def make_ps_train_step(
             agg_count = jnp.clip(
                 agg_count, cfg.num_aggregate_min, cfg.num_aggregate_max
             ).astype(jnp.int32)
+        bucket_peaks = None
+        hi_peak = _INT8_PEAK
+        if prec_tags is not None:
+            # same defense for the precision controller: clamp every tag
+            # into the declared lattice set, then gather the traced
+            # clipping peaks (0 / 7 / 127 / hi) the quantizer selects on
+            hi_peak = precision_hi_peak(cfg)
+            prec_tags = jnp.clip(prec_tags, 0, 3).astype(jnp.int32)
+            bucket_peaks = jnp.asarray(
+                precision_peaks(hi_peak), jnp.float32
+            )[prec_tags]
         w = lax.axis_index(axis)
         k_step = jax.random.fold_in(key, step_idx)
         k_mask = jax.random.fold_in(k_step, 0xA66)
@@ -1089,6 +1202,28 @@ def make_ps_train_step(
                         lambda g, h=hit, v=val: jnp.where(h, v, g), grads
                     )
 
+        bucket_sqnorm = None
+        if cfg.precision_adapt:
+            # per-bucket telemetry for the host-side PrecisionController:
+            # mesh-mean squared gradient norm per state_plan bucket,
+            # measured on the RAW per-worker gradients (pre-EF add-back,
+            # pre-mask — the controller ranks signal density, not wire
+            # artifacts). Static slices over the same flat buffer the
+            # guard probe flattens, so XLA CSEs the concat; one [n_buckets]
+            # f32 pmean rides the metrics dict the host already fetches.
+            lay = tree_layout(grads)
+            splan = state_plan(cfg, lay.total)
+            flat_raw = pad_flat(tree_to_flat(grads), splan)
+            bucket_sqnorm = lax.pmean(
+                jnp.stack([
+                    jnp.sum(
+                        jnp.square(lax.slice(flat_raw, (s0,), (s0 + sz,)))
+                    )
+                    for s0, sz in zip(splan.starts, splan.sizes)
+                ]),
+                axis,
+            )
+
         finite = None
         if cfg.nonfinite_guard:
             # mesh-wide agreement on "every worker's gradients are
@@ -1115,6 +1250,7 @@ def make_ps_train_step(
             params, new_opt, new_err = _sharded_ps_update(
                 params, opt_state, grads, tx, cfg, k_mask,
                 quant_key=quant_key, err=err, agg_count=agg_count,
+                bucket_peaks=bucket_peaks,
             )
             new_opt = tree_map(lambda a: a[None], new_opt)
             if cfg.error_feedback:
@@ -1156,6 +1292,8 @@ def make_ps_train_step(
                 pipelined=pipelined,
                 bucket_output=bucket_out,
                 wire_domain=cfg.wire_domain,
+                bucket_peaks=bucket_peaks,
+                lattice_hi_peak=hi_peak,
             )
             if cfg.error_feedback:
                 # the contribution (and the residual it defines) stays
@@ -1191,6 +1329,10 @@ def make_ps_train_step(
         metrics = lax.pmean(
             {"loss": loss, "prec1": prec1, "prec5": prec5}, axis
         )
+        if bucket_sqnorm is not None:
+            # already pmean'd; a VECTOR row in the metrics dict — the
+            # trainer pops it before its scalar float() sweep
+            metrics["bucket_sqnorm"] = bucket_sqnorm
         new_guard = guard_state
         if cfg.nonfinite_guard:
             # skip-step: a non-finite step becomes the identity update —
@@ -1239,17 +1381,19 @@ def make_ps_train_step(
         specs.guard_state,
         P(),
     )
-    # the adaptive signature threads the traced count through shard_map
-    # (replicated scalar); the static path keeps the 9-arg shape so its
-    # jaxpr — and the committed comm contract — is untouched
+    # the adaptive signatures thread the traced controller inputs through
+    # shard_map (replicated scalar count, replicated [n_buckets] tag
+    # vector — in that order); the static path keeps the 9-arg shape so
+    # its jaxpr — and the committed comm contract — is untouched
+    extra_specs = ()
+    if cfg.adaptive_aggregate:
+        extra_specs += (P(),)
+    if cfg.precision_adapt:
+        extra_specs += (P(),)
     mapped = jax.shard_map(
         worker_fn,
         mesh=mesh,
-        in_specs=(
-            base_in_specs + (P(),)
-            if cfg.adaptive_aggregate
-            else base_in_specs
-        ),
+        in_specs=base_in_specs + extra_specs,
         out_specs=out_specs,
         check_vma=False,
     )
@@ -1279,11 +1423,28 @@ def make_ps_train_step(
         )
         return new_state, metrics
 
+    # fixed-arity wrappers so the jitted signature names its extra args
+    # (count first, tags second — matching extra_specs above). The
+    # `donate_argnums=... if donate else ()` conditional stays inline in
+    # each return: pslint's PSL005 donor discovery reads exactly this
+    # idiom to learn the factory's donated positions and honor callers'
+    # donate=False opt-outs.
+    if cfg.adaptive_aggregate and cfg.precision_adapt:
+        def step_both(state: PSTrainState, batch, key, agg_count,
+                      prec_tags):
+            return step(state, batch, key, agg_count, prec_tags)
+
+        return jax.jit(step_both, donate_argnums=(0,) if donate else ())
     if cfg.adaptive_aggregate:
         def step_adaptive(state: PSTrainState, batch, key, agg_count):
             return step(state, batch, key, agg_count)
 
         return jax.jit(step_adaptive, donate_argnums=(0,) if donate else ())
+    if cfg.precision_adapt:
+        def step_precision(state: PSTrainState, batch, key, prec_tags):
+            return step(state, batch, key, prec_tags)
+
+        return jax.jit(step_precision, donate_argnums=(0,) if donate else ())
     return jax.jit(step, donate_argnums=(0,) if donate else ())
 
 

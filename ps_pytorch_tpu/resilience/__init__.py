@@ -19,6 +19,10 @@ matching machinery, in three parts:
   (shrink/grow, replicated<->ZeRO-1), and the adaptive aggregation
   controller turns the static backup-worker mask into a per-window
   response to observed stragglers.
+- ``precision``: the adaptive per-bucket precision controller — windowed
+  gradient-norm telemetry picks each wire bucket's lattice (skip / 4-bit
+  / int8 / hi) under an optional byte budget, in the mask controller's
+  exact mold (debounce, multihost consensus, schema-validated events).
 """
 
 from .elastic import (
@@ -32,6 +36,7 @@ from .elastic import (
 )
 from .faults import FaultPlan, resolve_fault_plan
 from .guard import GuardState, init_guard_state, tree_all_finite
+from .precision import PrecisionController, effective_wire_bytes
 from .retry import retry_io
 
 __all__ = [
@@ -39,6 +44,8 @@ __all__ = [
     "FaultPlan",
     "GuardState",
     "MeshGeometry",
+    "PrecisionController",
+    "effective_wire_bytes",
     "geometry_of",
     "init_guard_state",
     "load_geometry",

@@ -1,0 +1,266 @@
+"""Adaptive per-bucket precision: the host half.
+
+The traced half (PSConfig.precision_adapt) makes the train step take an
+int32 tag per wire bucket — skip / 4-bit / int8 / hi — and quantize each
+bucket onto the lattice its tag names, with NO retrace on tag change
+(ops/quantize.quantize_lattice: the tag only selects a traced clipping
+peak). This module is the controller that PICKS the tags, in the exact
+mold of elastic.AdaptiveMaskController: windowed telemetry in, one tiny
+deterministic policy, multihost consensus at window close, a
+schema-validated JSONL event on every change.
+
+Telemetry: the step's ``bucket_sqnorm`` metrics row — the mesh-mean
+squared gradient norm per bucket, [n_buckets] f32, one device fetch per
+step the trainer already pays for its metrics window. Per-bucket signal
+DENSITY (window-mean sqnorm / bucket size) is the ranking currency:
+Variance-based Gradient Compression (PAPERS.md) assigns rate by
+per-coordinate signal variance, and density is its cheap bucketed proxy.
+
+Policy (deliberately simple, fully deterministic):
+
+- RELATIVE thresholds against the window's densest bucket: a bucket at
+  <= 1e-8 of the max density carries noise — SKIP it (EF keeps its whole
+  gradient as residual, nothing is lost, just deferred); <= 1e-3 earns
+  the 4-bit lattice; >= 0.25 earns the HI lattice (finest the wire's
+  narrowest integer hop carries, ps.precision_hi_peak); else int8.
+- BUDGET: ``--wire-budget-bytes`` caps the per-step EFFECTIVE wire bytes
+  (sum of size_b * bytes-per-element of tag_b — what a byte-honest
+  transport would ship; the physical trace bytes are static, PSC108's
+  "adaptation reshapes values, never bytes" stance). Over budget, the
+  LOWEST-density bucket downgrades one notch, repeatedly — but the
+  budget never forces a SKIP (dropping signal entirely is the density
+  ladder's call, not the accountant's).
+- HYSTERESIS by debounce: a proposal is adopted only when two
+  consecutive windows propose the SAME tag vector — one noisy window
+  can never flap the wire.
+- CONSENSUS: hosts observe the same pmean'd telemetry in exact
+  arithmetic but a paranoid elementwise MIN over hosts' adopted tags is
+  applied at window close (finer lattice = larger tag, so min = the
+  coarsest any host wants = the cheapest — consensus can only reduce
+  effective bytes, never break the budget). Same contract discipline as
+  the mask controller: the registry declares the trainer's hookup
+  (``trainer.Trainer._tags_consensus``) and PSC110 verifies it is
+  consensus-shaped.
+
+A window whose telemetry contains any non-finite value adapts nothing
+(the guard is already skipping those steps; adapting on poisoned stats
+would launder a NaN into a policy change).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Optional, Sequence
+
+import numpy as np
+
+from ..ops.quantize import (
+    PREC_4BIT,
+    PREC_HI,
+    PREC_INT8,
+    PREC_SKIP,
+    PRECISION_TAG_NAMES,
+    precision_bytes_per_element,
+)
+
+logger = logging.getLogger("ps_pytorch_tpu")
+
+# relative-density ladder (fractions of the window's max density)
+SKIP_FRACTION = 1e-8
+FOURBIT_FRACTION = 1e-3
+HI_FRACTION = 0.25
+
+
+def effective_wire_bytes(
+    tags: Sequence[int], sizes: Sequence[int], hi_peak: int
+) -> int:
+    """Effective gradient-wire bytes one step ships under ``tags``: the
+    controller's budget currency and the bench A/B's evidence metric.
+    Skip = 0, 4-bit = size/2 (pack_int4's exact output size, rounded up
+    per bucket), int8 = size, hi = the minimal integer width holding
+    ``hi_peak``. Scale rows are tag-invariant and excluded — identical
+    on both sides of any comparison this number feeds."""
+    per_el = precision_bytes_per_element(hi_peak)
+    total = 0.0
+    for t, s in zip(tags, sizes):
+        total += per_el[int(t)] * int(s)
+    return int(np.ceil(total))
+
+
+class PrecisionController:
+    """Host half of adaptive per-bucket precision (module docstring has
+    the policy). Feed one ``record(step_no, bucket_sqnorm)`` per step;
+    it returns the int32 tag vector the NEXT step should trace (changes
+    only at window boundaries). ``consensus``, when given (multihost),
+    maps a proposed int32 tag vector to the elementwise min across
+    hosts — the trainer provides its PSC110-declared hookup."""
+
+    def __init__(self, cfg, sizes: Sequence[int], window: int,
+                 budget_bytes: Optional[int] = None, event_sink=None,
+                 consensus=None):
+        from ..parallel.ps import precision_hi_peak
+
+        if not cfg.precision_adapt:
+            raise ValueError(
+                "PrecisionController needs cfg.precision_adapt=True"
+            )
+        if window < 1:
+            raise ValueError(f"adapt window must be >= 1, got {window}")
+        self.sizes = np.asarray(sizes, np.int64)
+        if self.sizes.ndim != 1 or self.sizes.size < 1 or (
+            self.sizes <= 0
+        ).any():
+            raise ValueError(
+                f"bad bucket sizes {sizes!r}: need >= 1 positive entries "
+                f"(state_plan(cfg, total).sizes)"
+            )
+        self.hi_peak = precision_hi_peak(cfg)
+        self._bytes_per_el = precision_bytes_per_element(self.hi_peak)
+        static_int8 = effective_wire_bytes(
+            [PREC_INT8] * self.sizes.size, self.sizes, self.hi_peak
+        )
+        if budget_bytes is not None and budget_bytes < 1:
+            raise ValueError(f"bad wire budget {budget_bytes} (need >= 1)")
+        self.budget_bytes = (
+            int(budget_bytes) if budget_bytes is not None else None
+        )
+        self.static_int8_bytes = static_int8
+        self.window = int(window)
+        # start on the committed-contract lattice everywhere: the first
+        # window observes static-int8 behavior, adaptation is evidence-in
+        self.tags = np.full(self.sizes.size, PREC_INT8, np.int32)
+        self.adaptations = 0
+        self._sink = event_sink
+        self._consensus = consensus
+        self._steps = 0
+        self._sq_sum = np.zeros(self.sizes.size, np.float64)
+        self._finite = True
+        self._win_start: Optional[int] = None
+        self._pending: Optional[np.ndarray] = None
+
+    # ------------------------------------------------------------- policy
+
+    def _ladder(self, density: np.ndarray) -> np.ndarray:
+        """Relative-threshold tag proposal from per-element densities."""
+        dmax = float(density.max())
+        if dmax <= 0.0:
+            # an all-zero gradient window: keep the current tags (there
+            # is no signal to rank; skipping everything on silence would
+            # stall warmup)
+            return self.tags.copy()
+        rel = density / dmax
+        tags = np.full(density.size, PREC_INT8, np.int32)
+        tags[rel >= HI_FRACTION] = PREC_HI
+        tags[rel <= FOURBIT_FRACTION] = PREC_4BIT
+        tags[rel <= SKIP_FRACTION] = PREC_SKIP
+        return tags
+
+    def _enforce_budget(self, tags: np.ndarray,
+                        density: np.ndarray) -> np.ndarray:
+        """Downgrade lowest-density non-minimum buckets one notch at a
+        time until the effective bytes fit the budget (or nothing above
+        4-bit remains — the budget never forces a SKIP)."""
+        if self.budget_bytes is None:
+            return tags
+        tags = tags.copy()
+        order = np.argsort(density, kind="stable")  # cheapest signal first
+        while self.effective_bytes(tags) > self.budget_bytes:
+            downgraded = False
+            for b in order:
+                if tags[b] > PREC_4BIT:
+                    tags[b] -= 1
+                    downgraded = True
+                    break
+            if not downgraded:
+                logger.warning(
+                    "precision_adapt: wire budget %d B unreachable — "
+                    "floor is %d B with every bucket at 4-bit",
+                    self.budget_bytes, self.effective_bytes(tags),
+                )
+                break
+        return tags
+
+    # ----------------------------------------------------------- interface
+
+    def effective_bytes(self, tags: Optional[np.ndarray] = None) -> int:
+        return effective_wire_bytes(
+            self.tags if tags is None else tags, self.sizes, self.hi_peak
+        )
+
+    def record(self, step_no: int, bucket_sqnorm) -> np.ndarray:
+        """Feed one step's [n_buckets] mesh-mean squared-norm row;
+        returns the tag vector the NEXT step should use."""
+        sq = np.asarray(bucket_sqnorm, np.float64).reshape(-1)
+        if sq.size != self.sizes.size:
+            raise ValueError(
+                f"bucket_sqnorm has {sq.size} entries, plan has "
+                f"{self.sizes.size} buckets"
+            )
+        if self._win_start is None:
+            self._win_start = step_no
+        self._steps += 1
+        if not np.isfinite(sq).all():
+            self._finite = False
+        else:
+            self._sq_sum += sq
+        if self._steps >= self.window:
+            self._close_window(step_no)
+        return self.tags
+
+    def _close_window(self, step_no: int) -> None:
+        win_start, steps = self._win_start, self._steps
+        finite, sq_sum = self._finite, self._sq_sum
+        self._steps = 0
+        self._sq_sum = np.zeros(self.sizes.size, np.float64)
+        self._finite = True
+        self._win_start = None
+        if not finite:
+            self._pending = None  # poisoned window: adapt nothing
+            return
+        density = (sq_sum / steps) / self.sizes
+        proposal = self._enforce_budget(self._ladder(density), density)
+        # debounce: adopt only what two consecutive windows agree on
+        if self._pending is None or not np.array_equal(
+            self._pending, proposal
+        ):
+            self._pending = proposal
+            return
+        adopted = proposal
+        if self._consensus is not None:
+            # elementwise min across hosts: coarsest wins, so consensus
+            # can only shrink effective bytes — the budget still holds
+            adopted = np.minimum(
+                np.asarray(self._consensus(adopted), np.int32),
+                adopted,
+            ).astype(np.int32)
+        changed = int((adopted != self.tags).sum())
+        if changed:
+            self.tags = adopted.astype(np.int32)
+            self.adaptations += 1
+            counts = {
+                f"n_{name}": int((self.tags == t).sum())
+                for t, name in enumerate(PRECISION_TAG_NAMES)
+            }
+            eff = self.effective_bytes()
+            logger.info(
+                "precision_adapt: %d/%d buckets retagged after window "
+                "%d-%d (skip=%d 4bit=%d int8=%d hi=%d, effective %d B "
+                "vs static int8 %d B)",
+                changed, self.tags.size, win_start, step_no,
+                counts["n_skip"], counts["n_4bit"], counts["n_int8"],
+                counts["n_hi"], eff, self.static_int8_bytes,
+            )
+            if self._sink is not None:
+                self._sink({
+                    "kind": "precision_adapt",
+                    "step": step_no,
+                    "window_start": win_start,
+                    "changed": changed,
+                    "effective_bytes": eff,
+                    "budget_bytes": (
+                        self.budget_bytes
+                        if self.budget_bytes is not None
+                        else 0
+                    ),
+                    **counts,
+                })

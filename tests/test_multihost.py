@@ -47,6 +47,8 @@ _GLOO_FLAKE_SIGNS = (
     "gloo::EnforceNotMet",
     "Gloo all-reduce failed",
     "Connection reset by peer",
+    "Connection refused",
+    "Broken pipe",
 )
 
 
@@ -101,20 +103,20 @@ def _gloo_flaked(procs, outs, hung) -> bool:
 
 
 def _run_pair_with_gloo_retry(tmp_path, attempt_fn):
-    """Run one 2-process attempt; retry up to TWICE iff the failure
-    signature is the gloo transport's (a loaded container can flake two
-    attempts in a row — observed on full-suite runs; the signature gate
-    means a real failure still surfaces on its first shot).
-    ``attempt_fn()`` must spawn a fresh pair and return (procs, outs,
-    hung); stale metrics files are cleared between attempts so
+    """Run one 2-process attempt; retry up to THREE more times iff the
+    failure signature is the gloo transport's (a loaded container can
+    flake several attempts in a row — observed on full-suite runs; the
+    signature gate means a real failure still surfaces on its first
+    shot). ``attempt_fn()`` must spawn a fresh pair and return (procs,
+    outs, hung); stale metrics files are cleared between attempts so
     assertions never read the flaked run."""
-    for attempt in range(3):
+    for attempt in range(4):
         for i in (0, 1):
             mf = tmp_path / f"metrics_{i}.jsonl"
             if mf.exists():
                 mf.unlink()
         procs, outs, hung = attempt_fn()
-        if not (attempt < 2 and _gloo_flaked(procs, outs, hung)):
+        if not (attempt < 3 and _gloo_flaked(procs, outs, hung)):
             break
     if hung:
         pytest.fail(

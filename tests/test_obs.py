@@ -206,6 +206,10 @@ SAMPLE_EVENTS = {
     "mask_adapt": {"kind": "mask_adapt", "step": 20, "window_start": 11,
                    "from": 4, "to": 3, "slow_steps": 1,
                    "window_steps": 10},
+    "precision_adapt": {"kind": "precision_adapt", "step": 20,
+                        "window_start": 11, "changed": 7, "n_skip": 0,
+                        "n_4bit": 7, "n_int8": 0, "n_hi": 0,
+                        "effective_bytes": 215552, "budget_bytes": 250000},
     "resume_reshape": {"kind": "resume_reshape", "step": 6,
                        "from": {"num_workers": 8}, "to": {"num_workers": 4}},
     "ckpt_quarantined": {"kind": "ckpt_quarantined", "step": 6,

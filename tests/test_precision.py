@@ -1,0 +1,427 @@
+"""Adaptive per-bucket precision (ARCHITECTURE §6i).
+
+- the int4 lattice codec: pack/unpack round-trips any bucket length,
+  quantize_int4 keeps the int8 scheme's exact block-scale geometry at
+  peak 7, and the homomorphic int16 sum of int4 payloads is the exact
+  integer sum (bit-exact, no overflow through 4681 workers — the
+  capacity ACCUM_CAPACITY/accum_dtype pin and PSC113 prove from trace).
+- quantize_lattice at peak 127 is bit-exact against the static
+  quantize_int8 path (same q, same scales), so an all-int8 tag vector
+  ships the committed contract's wire values.
+- the PrecisionController policy: density ladder, budget enforcement
+  (never forces SKIP; warns when the floor is unreachable), debounce,
+  poisoned-window rejection, consensus min, schema-valid events.
+- e2e: the precision_adapt train step runs the SAME compiled program
+  for every tag vector (values, never bytes), all-int8 tags track the
+  static step, and skip/4-bit tags train finite with EF absorbing the
+  quantization error.
+"""
+
+import logging
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+from jax.sharding import PartitionSpec as P
+
+from ps_pytorch_tpu.models import build_model
+from ps_pytorch_tpu.obs.schema import validate_event
+from ps_pytorch_tpu.ops.quantize import (
+    ACCUM_CAPACITY,
+    PREC_4BIT,
+    PREC_HI,
+    PREC_INT8,
+    PREC_SKIP,
+    accum_capacity,
+    accum_dtype,
+    pack_int4,
+    precision_bytes_per_element,
+    precision_peaks,
+    quantize_int4,
+    quantize_int8,
+    quantize_lattice,
+    unpack_int4,
+)
+from ps_pytorch_tpu.optim import build_optimizer
+from ps_pytorch_tpu.parallel import (
+    WORKER_AXIS,
+    PSConfig,
+    init_ps_state,
+    make_mesh,
+    make_ps_train_step,
+    shard_batch,
+    shard_state,
+)
+from ps_pytorch_tpu.parallel.ps import precision_hi_peak, state_plan
+from ps_pytorch_tpu.resilience.precision import (
+    PrecisionController,
+    effective_wire_bytes,
+)
+
+N = 8
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return make_mesh(num_workers=N, axis_name=WORKER_AXIS)
+
+
+# ------------------------------------------------------------ int4 codec
+
+
+@pytest.mark.parametrize("n", [1, 2, 7, 16, 33, 1000])
+def test_pack_int4_round_trips_any_length(n):
+    rng = np.random.RandomState(n)
+    q = jnp.asarray(rng.randint(-7, 8, size=n), jnp.int8)
+    packed = jax.jit(pack_int4)(q)
+    assert packed.dtype == jnp.uint8
+    assert packed.shape == (-(-n // 2),)  # two values per byte
+
+    def unpack(p):
+        return unpack_int4(p, n)
+
+    out = jax.jit(unpack)(packed)
+    np.testing.assert_array_equal(np.asarray(out), np.asarray(q))
+
+
+def test_quantize_int4_same_block_geometry_as_int8():
+    """Same carving, same absmax association — the int4 scale is exactly
+    the int8 scale rescaled by 127/7, and the round-trip error is within
+    half an int4 step per element."""
+    rng = np.random.RandomState(0)
+    x = jnp.asarray(rng.randn(1000).astype(np.float32))
+    def int4_blocked(v):
+        return quantize_int4(v, block_size=32)
+
+    def int8_blocked(v):
+        return quantize_int8(v, block_size=32)
+
+    q4, s4 = jax.jit(int4_blocked)(x)
+    q8, s8 = jax.jit(int8_blocked)(x)
+    assert q4.shape == q8.shape and s4.shape == s8.shape
+    assert int(jnp.max(jnp.abs(q4))) <= 7
+    np.testing.assert_allclose(
+        np.asarray(s4), np.asarray(s8) * (127.0 / 7.0), rtol=1e-6
+    )
+    deq = np.asarray(q4.astype(jnp.float32) * s4).reshape(-1)[:1000]
+    err = np.abs(deq - np.asarray(x))
+    bound = np.repeat(np.asarray(s4).reshape(-1), 32)[:1000] * 0.5 + 1e-7
+    assert (err <= bound).all(), err.max()
+
+
+@pytest.mark.parametrize("bs", [0, 32], ids=["per_tensor", "per_block"])
+def test_lattice_peak127_bit_exact_vs_static_int8(bs):
+    """An all-int8 tag vector must ship the committed contract's exact
+    wire values: quantize_lattice at peak 127 == quantize_int8."""
+    rng = np.random.RandomState(1)
+    x = jnp.asarray(rng.randn(777).astype(np.float32))
+
+    def lattice127(v):
+        return quantize_lattice(v, 127.0, block_size=bs)
+
+    def int8_ref(v):
+        return quantize_int8(v, block_size=bs)
+
+    ql, sl = jax.jit(lattice127)(x)
+    q8, s8 = jax.jit(int8_ref)(x)
+    np.testing.assert_array_equal(np.asarray(ql), np.asarray(q8))
+    np.testing.assert_array_equal(np.asarray(sl), np.asarray(s8))
+
+
+def test_lattice_peak_zero_is_skip():
+    """Peak 0 (the SKIP tag) ships nothing: q == 0, scale == 0 — EF keeps
+    the whole gradient as residual."""
+    x = jnp.asarray(np.random.RandomState(2).randn(64).astype(np.float32))
+
+    def lattice0(v):
+        return quantize_lattice(v, 0.0, block_size=32)
+
+    q, s = jax.jit(lattice0)(x)
+    assert not np.asarray(q).any() and not np.asarray(s).any()
+
+
+def test_int4_capacity_flips_accum_dtype_at_4681():
+    """The int4 lattice's homomorphic capacity: 4681 * 7 = 32767 fills
+    int16 exactly, one more worker must widen — the bound PSC113 proves
+    from the traced clamp."""
+    assert accum_capacity("int16", 7) == (2 ** 15 - 1) // 7 == 4681
+    assert 4681 * 7 == np.iinfo(np.int16).max
+    assert accum_dtype(4681, 7) == jnp.int16
+    assert accum_dtype(4682, 7) == jnp.int32
+    # the committed int8 table is the same formula at peak 127
+    assert ACCUM_CAPACITY["int16"] == accum_capacity("int16", 127) == 258
+
+
+def test_homomorphic_int4_lattice_sum_bit_exact(mesh):
+    """The 4-bit homomorphic pin: the int16 psum of shared-scale int4
+    payloads IS the exact integer sum of the per-worker payloads — the
+    compressed-domain sum loses nothing the per-worker lattice had."""
+    rng = np.random.RandomState(3)
+    x = jnp.asarray(rng.randn(512).astype(np.float32))
+
+    def body(v):
+        w = jax.lax.axis_index(WORKER_AXIS).astype(jnp.float32)
+        local = v * (1.0 + 0.1 * w)
+        q, scale = quantize_int4(
+            local, axis_name=WORKER_AXIS, block_size=32
+        )
+        acc = jax.lax.psum(q.astype(jnp.int16), WORKER_AXIS)
+        each = jax.lax.all_gather(q, WORKER_AXIS)  # [N, nb, bs]
+        return acc, each, scale
+
+    acc, each, scale = jax.jit(
+        jax.shard_map(
+            body, mesh=mesh, in_specs=(P(),), out_specs=P(),
+            check_vma=False,
+        )
+    )(x)
+    np.testing.assert_array_equal(
+        np.asarray(acc, np.int64),
+        np.asarray(each, np.int64).sum(axis=0),
+    )
+    assert int(np.abs(np.asarray(acc)).max()) <= N * 7  # capacity honest
+
+
+# ---------------------------------------------------- tag tables / pricing
+
+
+def test_precision_tables_are_consistent():
+    peaks = precision_peaks(4095)
+    np.testing.assert_array_equal(peaks, [0.0, 7.0, 127.0, 4095.0])
+    assert precision_bytes_per_element(127) == (0.0, 0.5, 1.0, 1.0)
+    assert precision_bytes_per_element(4095) == (0.0, 0.5, 1.0, 2.0)
+    assert precision_bytes_per_element(32767) == (0.0, 0.5, 1.0, 2.0)
+    assert precision_bytes_per_element(32768) == (0.0, 0.5, 1.0, 4.0)
+
+
+def test_effective_wire_bytes_prices_each_tag():
+    sizes = [100, 100, 100, 101]
+    tags = [PREC_SKIP, PREC_4BIT, PREC_INT8, PREC_HI]
+    # skip 0 + 4bit 50 + int8 100 + hi 2*101 (int16-width hi lattice)
+    assert effective_wire_bytes(tags, sizes, 4095) == 0 + 50 + 100 + 202
+    # odd 4-bit bucket rounds up to pack_int4's real output size
+    assert effective_wire_bytes([PREC_4BIT], [101], 127) == 51
+
+
+# ------------------------------------------------------------- controller
+
+
+def _cfg(**kw):
+    kw.setdefault("num_workers", N)
+    kw.setdefault("compress", "int8")
+    kw.setdefault("bucket_bytes", 64 << 10)
+    kw.setdefault("precision_adapt", True)
+    return PSConfig(**kw)
+
+
+def _feed(ctrl, sq, start=0, steps=None):
+    """Feed identical telemetry rows for `steps` steps (default: enough
+    for two window closes — proposal + debounced adoption)."""
+    steps = 2 * ctrl.window if steps is None else steps
+    for i in range(start, start + steps):
+        ctrl.record(i, sq)
+    return ctrl.tags
+
+
+def test_controller_starts_static_int8_and_ladders():
+    cfg = _cfg()
+    ctrl = PrecisionController(cfg, [100, 100, 100], window=2)
+    assert (ctrl.tags == PREC_INT8).all()
+    # densities: dominant / middling / negligible -> hi / int8 / 4bit
+    _feed(ctrl, np.array([100.0, 1.0, 1e-4]) * np.asarray(ctrl.sizes))
+    np.testing.assert_array_equal(
+        ctrl.tags, [PREC_HI, PREC_INT8, PREC_4BIT]
+    )
+    assert ctrl.adaptations == 1
+
+
+def test_controller_budget_downgrades_but_never_skips():
+    cfg = _cfg()
+    sizes = [100, 100, 100]
+    # budget below even the all-4-bit floor: enforcement must stop at
+    # 4-bit everywhere (never SKIP) and warn, not loop forever
+    ctrl = PrecisionController(cfg, sizes, window=1, budget_bytes=10)
+    _feed(ctrl, np.ones(3))
+    assert (ctrl.tags == PREC_4BIT).all()
+    assert ctrl.effective_bytes() == 150  # the floor, above budget
+    # a reachable budget holds as an invariant of the adopted tags
+    ctrl2 = PrecisionController(cfg, sizes, window=1, budget_bytes=200)
+    _feed(ctrl2, np.array([100.0, 1.0, 1.0]))
+    assert ctrl2.effective_bytes() <= 200
+    assert not (ctrl2.tags == PREC_SKIP).any()
+
+
+def test_controller_debounce_needs_two_agreeing_windows():
+    cfg = _cfg()
+    ctrl = PrecisionController(cfg, [100, 100], window=1)
+    ctrl.record(0, np.array([100.0, 1e-4]) * 100)
+    assert ctrl.adaptations == 0  # first window only proposes
+    ctrl.record(1, np.array([1e-4, 100.0]) * 100)  # disagrees: re-arm
+    assert ctrl.adaptations == 0
+    ctrl.record(2, np.array([1e-4, 100.0]) * 100)
+    assert ctrl.adaptations == 1  # two consecutive agreeing windows
+    np.testing.assert_array_equal(ctrl.tags, [PREC_4BIT, PREC_HI])
+
+
+def test_controller_poisoned_window_adapts_nothing():
+    cfg = _cfg()
+    ctrl = PrecisionController(cfg, [100, 100], window=1)
+    sq = np.array([100.0, 1e-4]) * 100
+    ctrl.record(0, sq)
+    ctrl.record(1, np.array([np.nan, 1.0]))  # poisoned: resets debounce
+    ctrl.record(2, sq)
+    assert ctrl.adaptations == 0  # the nan window broke the agreement
+    ctrl.record(3, sq)
+    assert ctrl.adaptations == 1
+
+
+def test_controller_consensus_min_coarsens():
+    cfg = _cfg()
+    seen = []
+
+    def consensus(proposed):
+        seen.append(proposed.copy())
+        out = proposed.copy()
+        out[0] = PREC_4BIT  # another host wants bucket 0 coarser
+        return out
+
+    ctrl = PrecisionController(
+        cfg, [100, 100], window=1, consensus=consensus
+    )
+    _feed(ctrl, np.array([100.0, 50.0]) * 100)
+    assert seen, "consensus hook never consulted"
+    assert ctrl.tags[0] == PREC_4BIT  # min(local HI, remote 4bit)
+    assert ctrl.tags[1] == PREC_HI
+
+
+def test_controller_events_are_schema_valid():
+    cfg = _cfg()
+    events = []
+    ctrl = PrecisionController(
+        cfg, [100, 100, 100], window=2, budget_bytes=200,
+        event_sink=events.append,
+    )
+    _feed(ctrl, np.array([100.0, 1.0, 1e-4]) * 100)
+    assert len(events) == 1
+    e = validate_event(dict(events[0]))
+    assert e["kind"] == "precision_adapt"
+    assert e["budget_bytes"] == 200
+    assert e["effective_bytes"] == ctrl.effective_bytes() <= 200
+    assert (e["n_skip"] + e["n_4bit"] + e["n_int8"] + e["n_hi"]) == 3
+    assert e["changed"] >= 1 and e["step"] > e["window_start"] >= 0
+
+
+def test_controller_rejects_bad_inputs():
+    with pytest.raises(ValueError, match="precision_adapt"):
+        PrecisionController(
+            PSConfig(num_workers=N, compress="int8"), [10], window=1
+        )
+    cfg = _cfg()
+    with pytest.raises(ValueError, match="window"):
+        PrecisionController(cfg, [10], window=0)
+    with pytest.raises(ValueError, match="sizes"):
+        PrecisionController(cfg, [], window=1)
+    with pytest.raises(ValueError, match="budget"):
+        PrecisionController(cfg, [10], window=1, budget_bytes=0)
+    ctrl = PrecisionController(cfg, [10, 10], window=1)
+    with pytest.raises(ValueError, match="buckets"):
+        ctrl.record(0, np.ones(3))
+
+
+def test_precision_hi_peak_by_wire():
+    # dequant int8: int32 psum headroom, capped at the int16-width lattice
+    assert precision_hi_peak(_cfg()) == 32767
+    # 2-round: the a2a payload IS int8 — hi can't exceed the carrier
+    assert precision_hi_peak(_cfg(compress="int8_2round")) == 127
+    # homomorphic: bounded by the accumulator dtype's capacity at N=8
+    hom = _cfg(compress="int8", wire_domain="homomorphic")
+    assert precision_hi_peak(hom) == min(
+        np.iinfo(np.int16).max // N, 32767
+    ) == 4095
+
+
+# ------------------------------------------------------------------- e2e
+
+
+def _lenet_setup(mesh, cfg, seed=0):
+    rng = np.random.RandomState(seed)
+    batch = {
+        "image": rng.rand(64, 28, 28, 1).astype(np.float32),
+        "label": rng.randint(0, 10, size=(64,)),
+    }
+    model = build_model("LeNet")
+    tx = build_optimizer("sgd", 0.01, momentum=0.9, flat=True)
+    state = init_ps_state(model, tx, cfg, jax.random.key(0), (28, 28, 1))
+    state = shard_state(state, mesh, cfg)
+    step = make_ps_train_step(model, tx, cfg, mesh, donate=False)
+    return state, shard_batch(batch, mesh, cfg), step
+
+
+def test_e2e_all_int8_tags_track_static_step(mesh):
+    """An all-int8 tag vector must reproduce the static int8 step: the
+    wire values are bit-exact (test_lattice_peak127_bit_exact...), so
+    params may differ only by XLA fusion ULPs in the optimizer — tight
+    allclose, NOT array_equal (documented: the precision_adapt program
+    carries extra traced operands, so XLA schedules the update
+    differently at ~1e-6 relative)."""
+    base = PSConfig(
+        num_workers=N, compress="int8", quant_block_size=32,
+        bucket_bytes=64 << 10, error_feedback=True,
+    )
+    adap = PSConfig(
+        num_workers=N, compress="int8", quant_block_size=32,
+        bucket_bytes=64 << 10, error_feedback=True, precision_adapt=True,
+    )
+    state_s, batch_s, step_s = _lenet_setup(mesh, base)
+    state_a, batch_a, step_a = _lenet_setup(mesh, adap)
+    n_buckets = state_plan(adap, state_a.params.layout.total).n_buckets
+    tags = jnp.full((n_buckets,), PREC_INT8, jnp.int32)
+    key = jax.random.key(7)
+    for _ in range(2):
+        state_s, m_s = step_s(state_s, batch_s, key)
+        state_a, m_a = step_a(state_a, batch_a, key, tags)
+    np.testing.assert_allclose(
+        np.asarray(state_a.params.flat),
+        np.asarray(state_s.params.flat),
+        rtol=2e-5, atol=1e-6,
+    )
+    np.testing.assert_allclose(
+        float(m_a["loss"]), float(m_s["loss"]), rtol=1e-5
+    )
+
+
+def test_e2e_mixed_tags_same_program_no_retrace(mesh):
+    """Every tag vector — skip, 4-bit, mixed, hi — runs the ONE compiled
+    program (values, never bytes: PSC108), emits the bucket_sqnorm
+    telemetry row, and trains finite with EF absorbing the error."""
+    cfg = PSConfig(
+        num_workers=N, compress="int8_2round", quant_block_size=32,
+        bucket_bytes=64 << 10, error_feedback=True,
+        wire_domain="homomorphic", precision_adapt=True,
+    )
+    state, batch, step = _lenet_setup(mesh, cfg)
+    n_buckets = state_plan(cfg, state.params.layout.total).n_buckets
+    hi = precision_hi_peak(cfg)
+    key = jax.random.key(3)
+    vectors = [
+        np.full(n_buckets, PREC_INT8),
+        np.full(n_buckets, PREC_4BIT),
+        np.full(n_buckets, PREC_SKIP),
+        np.arange(n_buckets) % 4,          # mixed, incl. HI
+    ]
+    for i, tags in enumerate(vectors):
+        state, metrics = step(
+            state, batch, key, jnp.asarray(tags, jnp.int32)
+        )
+        assert np.isfinite(float(metrics["loss"])), (i, tags)
+        sq = np.asarray(metrics["bucket_sqnorm"])
+        assert sq.shape == (n_buckets,) and np.isfinite(sq).all()
+    # one compiled program across all four tag vectors
+    assert step._cache_size() == 1
+    # EF carried a residual for the skip step (the whole gradient)
+    leaves = [np.asarray(l) for l in
+              jax.tree_util.tree_leaves(jax.device_get(state.comm_state))]
+    assert leaves and all(np.isfinite(l).all() for l in leaves)
+    assert max(np.abs(l).max() for l in leaves) > 0
+    assert precision_peaks(hi)[PREC_HI] == float(hi)

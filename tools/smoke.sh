@@ -7,7 +7,9 @@
 # mask under a straggler storm), the flat-state
 # default (int8 + EF + guard NaN-inject), the homomorphic
 # compressed-domain wire (2round int8 + EF + 64 KiB buckets + pipelined
-# overlap + NaN-inject), the LM trainer on tp with
+# overlap + NaN-inject), the adaptive per-bucket precision wire
+# (telemetry-driven skip/4-bit/int8/hi retag under a byte budget), the
+# LM trainer on tp with
 # vocab-parallel embedding + the LM evaluator with KV-cache sampling,
 # the serving engine under open-loop traffic with one hot checkpoint
 # rollover, the observability leg (traced train + serve merged into one
@@ -157,6 +159,44 @@ assert trains and math.isfinite(trains[-1]["loss"]), trains
 print("homomorphic smoke: guard skipped %d step(s) on the int8 "
       "compressed-domain wire, final loss %.3f"
       % (skips[-1]["skipped_steps"], trains[-1]["loss"]))
+PYEOF
+
+# adaptive-precision leg (ARCHITECTURE §6i, --precision-adapt): the same
+# homomorphic 2round+EF wire, but every 64 KiB bucket carries a traced
+# precision tag (skip/4-bit/int8/hi) the host PrecisionController
+# retags from per-step gradient-norm telemetry — values, never bytes,
+# no retrace. The --wire-budget-bytes cap sits just ABOVE the all-4-bit
+# floor (27 x 16 Ki elements / 2 = 215552 B) and well below the static
+# int8 wire (431104 B), so budget enforcement drives every window's
+# proposal to the same all-4-bit vector — the debounce adopts it at the
+# second window close regardless of how the per-bucket densities move.
+# The run must land >= 1 schema-valid precision_adapt event whose
+# effective bytes respect the budget, and train to a clean finish
+run python -m ps_pytorch_tpu.cli.train \
+    --network LeNet --dataset MNIST --num-workers 8 --batch-size 64 \
+    --max-steps 6 --eval-freq 3 --log-interval 1 \
+    --compress-grad 2round --quant-block-size 32 --error-feedback \
+    --bucket-bytes 65536 --wire-domain homomorphic \
+    --precision-adapt --adapt-window 2 --wire-budget-bytes 220000 \
+    --metrics-file "$TMP/precadapt/metrics.jsonl" \
+    --train-dir "$TMP/precadapt"
+run python - "$TMP/precadapt/metrics.jsonl" <<'PYEOF'
+import json, math, sys
+from ps_pytorch_tpu.obs.schema import validate_event
+events = [json.loads(l) for l in open(sys.argv[1])]
+prec = [e for e in events if e.get("kind") == "precision_adapt"]
+assert prec and prec[0]["changed"] >= 1, prec
+for e in prec:
+    validate_event(dict(e))
+    assert e["effective_bytes"] <= e["budget_bytes"], e
+trains = [e for e in events if e.get("kind") == "train"]
+assert trains and all(math.isfinite(e["loss"]) for e in trains), trains
+last = prec[-1]
+print("precision smoke: %d retag(s), tags skip=%d 4bit=%d int8=%d hi=%d, "
+      "effective %d B under budget %d B, final loss %.3f"
+      % (len(prec), last["n_skip"], last["n_4bit"], last["n_int8"],
+         last["n_hi"], last["effective_bytes"], last["budget_bytes"],
+         trains[-1]["loss"]))
 PYEOF
 
 run python -m ps_pytorch_tpu.cli.train_lm \
